@@ -1,0 +1,158 @@
+"""TxVote sign bytes + wire encoding tests (mirrors reference types/vote_test.go,
+with vectors regenerated for the actual CanonicalTxVote shape — the reference's
+own vectors are stale copies of upstream Vote vectors, per SURVEY.md section 0)."""
+
+import time
+
+from txflow_tpu.codec import amino
+from txflow_tpu.crypto import ed25519
+from txflow_tpu.crypto.hash import address_hash, tx_hash, tx_key
+from txflow_tpu.types import (
+    MAX_VOTE_BYTES,
+    MockPV,
+    TxVote,
+    canonical_sign_bytes,
+    decode_tx_vote,
+    encode_tx_vote,
+)
+
+# 2017-12-25T03:00:01.234Z, the reference's example timestamp.
+STAMP_NS = 1514170801 * 1_000_000_000 + 234_000_000
+
+
+def example_vote() -> TxVote:
+    return TxVote(
+        height=12345,
+        tx_hash=tx_hash(b"tx_hash"),
+        tx_key=tx_key(b"tx_hash"),
+        timestamp_ns=STAMP_NS,
+        validator_address=address_hash(b"validator_address"),
+    )
+
+
+def test_sign_bytes_structure():
+    vote = example_vote()
+    sb = vote.sign_bytes("test_chain_id")
+    # Length-prefixed.
+    total, pos = amino.read_uvarint(sb)
+    assert pos + total == len(sb)
+    r = amino.AminoReader(sb, pos)
+    # Field 1: height fixed64.
+    fnum, typ3 = r.read_field_key()
+    assert (fnum, typ3) == (1, amino.TYP3_8BYTE)
+    assert r.read_fixed64() == 12345
+    # Field 2: tx hash string (64 hex chars).
+    fnum, typ3 = r.read_field_key()
+    assert (fnum, typ3) == (2, amino.TYP3_BYTELEN)
+    assert r.read_bytes().decode() == tx_hash(b"tx_hash")
+    # Field 3: TxKey — ALWAYS 32 zero bytes (canonicalization drops the key).
+    fnum, typ3 = r.read_field_key()
+    assert (fnum, typ3) == (3, amino.TYP3_BYTELEN)
+    assert r.read_bytes() == bytes(32)
+    # Field 4: timestamp.
+    fnum, typ3 = r.read_field_key()
+    assert (fnum, typ3) == (4, amino.TYP3_BYTELEN)
+    assert amino.decode_time_body(r.read_bytes()) == STAMP_NS
+    # Field 5: chain id.
+    fnum, typ3 = r.read_field_key()
+    assert (fnum, typ3) == (5, amino.TYP3_BYTELEN)
+    assert r.read_bytes() == b"test_chain_id"
+    assert r.eof()
+
+
+def test_sign_bytes_empty_vote():
+    # Height 0 and empty tx hash elided; TxKey + timestamp present.
+    sb = canonical_sign_bytes("", 0, "", STAMP_NS)
+    total, pos = amino.read_uvarint(sb)
+    r = amino.AminoReader(sb, pos)
+    fnum, typ3 = r.read_field_key()
+    assert fnum == 3  # first non-elided field is TxKey
+    r.read_bytes()
+    fnum, _ = r.read_field_key()
+    assert fnum == 4
+    r.read_bytes()
+    assert r.eof()
+
+
+def test_sign_bytes_pinned_vector():
+    # Pinned regression vector: any change to the canonical encoding breaks
+    # every signature in the network.
+    sb = canonical_sign_bytes("test_chain", 1, "AB", 1_000_000_000)
+    want = bytes(
+        [0x3F]  # total length 63: 9 (height) + 4 (hash) + 34 (key) + 4 (ts) + 12 (chain)
+        + [0x09] + [1, 0, 0, 0, 0, 0, 0, 0]  # height fixed64 = 1
+        + [0x12, 0x02] + list(b"AB")  # tx hash
+        + [0x1A, 0x20] + [0] * 32  # zero TxKey
+        + [0x22, 0x02, 0x08, 0x01]  # timestamp {seconds: 1}
+        + [0x2A, 0x0A] + list(b"test_chain")
+    )
+    assert sb == want
+
+
+def test_sign_and_verify():
+    pv = MockPV()
+    vote = example_vote()
+    vote.validator_address = pv.get_address()
+    pv.sign_tx_vote("test_chain_id", vote)
+    assert vote.verify("test_chain_id", pv.get_pub_key()) is None
+    # Wrong chain id fails.
+    assert vote.verify("other_chain", pv.get_pub_key()) is not None
+    # Wrong pubkey fails on address check.
+    other = MockPV()
+    assert vote.verify("test_chain_id", other.get_pub_key()) == (
+        "invalid validator address"
+    )
+
+
+def test_broken_signer_rejected():
+    pv = MockPV(break_tx_vote_signing=True)
+    vote = example_vote()
+    vote.validator_address = pv.get_address()
+    pv.sign_tx_vote("test_chain_id", vote)
+    assert vote.verify("test_chain_id", pv.get_pub_key()) == "invalid signature"
+
+
+def test_wire_roundtrip():
+    pv = MockPV()
+    vote = example_vote()
+    vote.validator_address = pv.get_address()
+    pv.sign_tx_vote("test_chain_id", vote)
+    enc = encode_tx_vote(vote)
+    dec = decode_tx_vote(enc)
+    assert dec == vote
+    assert vote.size() == len(enc)
+
+
+def test_max_vote_bytes():
+    # A fully-populated vote must fit in the reference's 223-byte cap.
+    pv = MockPV()
+    vote = example_vote()
+    vote.validator_address = pv.get_address()
+    pv.sign_tx_vote("test_chain_id", vote)
+    assert vote.size() <= MAX_VOTE_BYTES
+
+
+def test_validate_basic():
+    pv = MockPV()
+    vote = example_vote()
+    vote.validator_address = pv.get_address()
+    pv.sign_tx_vote("test_chain_id", vote)
+    assert vote.validate_basic() is None
+    bad = vote.copy()
+    bad.height = -1
+    assert bad.validate_basic() is not None
+    bad = vote.copy()
+    bad.validator_address = b"\x00"
+    assert bad.validate_basic() is not None
+    bad = vote.copy()
+    bad.signature = None
+    assert bad.validate_basic() is not None
+    bad = vote.copy()
+    bad.signature = bytes(65)
+    assert bad.validate_basic() is not None
+
+
+def test_timestamp_now_default():
+    before = time.time_ns()
+    vote = TxVote(1, "AB", bytes(32))
+    assert before <= vote.timestamp_ns <= time.time_ns()

@@ -1,0 +1,194 @@
+"""TxVoteSet quorum semantics (mirrors reference types/vote_set_test.go)."""
+
+import pytest
+
+from txflow_tpu.crypto.hash import tx_hash, tx_key
+from txflow_tpu.types import (
+    ErrVoteInvalidSignature,
+    ErrVoteInvalidValidatorIndex,
+    ErrVoteNonDeterministicSignature,
+    MockPV,
+    TxVote,
+    TxVoteSet,
+    Validator,
+    ValidatorSet,
+)
+
+CHAIN_ID = "test_chain"
+
+
+def rand_vote_set(n: int, power: int = 1):
+    pvs = [MockPV() for _ in range(n)]
+    vals = [Validator.from_pub_key(pv.get_pub_key(), power) for pv in pvs]
+    val_set = ValidatorSet(vals)
+    # Order signers to match validator-set (address-sorted) order.
+    pvs.sort(key=lambda pv: pv.get_address())
+    tx = b"the tx"
+    vote_set = TxVoteSet(CHAIN_ID, 1, tx_hash(tx), tx_key(tx), val_set)
+    return vote_set, val_set, pvs, tx
+
+
+def signed_vote(pv: MockPV, tx: bytes, height: int = 1) -> TxVote:
+    vote = TxVote(
+        height=height,
+        tx_hash=tx_hash(tx),
+        tx_key=tx_key(tx),
+        validator_address=pv.get_address(),
+    )
+    pv.sign_tx_vote(CHAIN_ID, vote)
+    return vote
+
+
+def test_add_vote():
+    vote_set, _, pvs, tx = rand_vote_set(10)
+    pv = pvs[0]
+    assert vote_set.get_by_address(pv.get_address()) is None
+    assert not vote_set.has_two_thirds_majority()
+
+    added, err = vote_set.add_vote(signed_vote(pv, tx))
+    assert added and err is None
+    assert vote_set.get_by_address(pv.get_address()) is not None
+    assert vote_set.stake() == 1
+    assert not vote_set.has_two_thirds_majority()
+
+
+def test_duplicate_vote_silently_ignored():
+    vote_set, _, pvs, tx = rand_vote_set(4)
+    vote = signed_vote(pvs[0], tx)
+    added, err = vote_set.add_vote(vote)
+    assert added and err is None
+    added, err = vote_set.add_vote(vote.copy())
+    assert not added and err is None  # exact duplicate: no error
+    assert vote_set.stake() == 1
+
+
+def test_non_deterministic_signature_rejected():
+    vote_set, _, pvs, tx = rand_vote_set(4)
+    v1 = signed_vote(pvs[0], tx)
+    added, _ = vote_set.add_vote(v1)
+    assert added
+    # Same validator, different timestamp => different signature.
+    v2 = TxVote(
+        height=1,
+        tx_hash=tx_hash(tx),
+        tx_key=tx_key(tx),
+        timestamp_ns=v1.timestamp_ns + 1,
+        validator_address=pvs[0].get_address(),
+    )
+    pvs[0].sign_tx_vote(CHAIN_ID, v2)
+    assert v2.signature != v1.signature
+    added, err = vote_set.add_vote(v2)
+    assert not added
+    assert isinstance(err, ErrVoteNonDeterministicSignature)
+    assert vote_set.stake() == 1  # first-signature-wins, not double counted
+
+
+def test_non_validator_rejected():
+    vote_set, _, _, tx = rand_vote_set(4)
+    outsider = MockPV()
+    added, err = vote_set.add_vote(signed_vote(outsider, tx))
+    assert not added
+    assert isinstance(err, ErrVoteInvalidValidatorIndex)
+
+
+def test_bad_signature_rejected():
+    vote_set, _, pvs, tx = rand_vote_set(4)
+    vote = signed_vote(pvs[0], tx)
+    vote.signature = bytes(64)
+    added, err = vote_set.add_vote(vote)
+    assert not added
+    assert isinstance(err, ErrVoteInvalidSignature)
+    # Signature by the wrong key.
+    vote = signed_vote(pvs[0], tx)
+    vote.signature = MockPV().sign_bytes_raw(vote.sign_bytes(CHAIN_ID))
+    added, err = vote_set.add_vote(vote)
+    assert not added
+    assert isinstance(err, ErrVoteInvalidSignature)
+
+
+def test_two_thirds_majority_equal_power():
+    # 10 validators, power 1 each: quorum = 10*2//3 + 1 = 7.
+    vote_set, _, pvs, tx = rand_vote_set(10)
+    for i in range(6):
+        added, _ = vote_set.add_vote(signed_vote(pvs[i], tx))
+        assert added
+    assert not vote_set.has_two_thirds_majority()
+    assert not vote_set.has_two_thirds_any()
+    added, _ = vote_set.add_vote(signed_vote(pvs[6], tx))
+    assert added
+    assert vote_set.has_two_thirds_majority()
+    assert vote_set.has_two_thirds_any()
+    assert vote_set.is_commit()
+
+
+def test_two_thirds_majority_weighted():
+    # Powers 1,1,1,10 => total 13, quorum = 13*2//3+1 = 9: only the big
+    # validator matters.
+    pvs = [MockPV() for _ in range(4)]
+    pvs.sort(key=lambda pv: pv.get_address())
+    powers = [1, 1, 1, 10]
+    vals = [
+        Validator.from_pub_key(pv.get_pub_key(), p) for pv, p in zip(pvs, powers)
+    ]
+    val_set = ValidatorSet(vals)
+    tx = b"weighted"
+    vote_set = TxVoteSet(CHAIN_ID, 1, tx_hash(tx), tx_key(tx), val_set)
+    by_addr = {pv.get_address(): (pv, p) for pv, p in zip(pvs, powers)}
+
+    small = [pv for pv, p in by_addr.values() if p == 1]
+    big = next(pv for pv, p in by_addr.values() if p == 10)
+    for pv in small:
+        vote_set.add_vote(signed_vote(pv, tx))
+    assert vote_set.stake() == 3
+    assert not vote_set.has_two_thirds_majority()
+    vote_set.add_vote(signed_vote(big, tx))
+    assert vote_set.stake() == 13
+    assert vote_set.has_two_thirds_majority()
+
+
+def test_make_commit():
+    vote_set, _, pvs, tx = rand_vote_set(4)
+    with pytest.raises(RuntimeError):
+        vote_set.make_commit()
+    for pv in pvs[:3]:  # quorum = 4*2//3+1 = 3
+        vote_set.add_vote(signed_vote(pv, tx))
+    assert vote_set.has_two_thirds_majority()
+    commit = vote_set.make_commit()
+    assert commit.tx_hash == tx_hash(tx)
+    assert len(commit.commits) == 3
+    assert commit.height() == 1
+    # Commit sigs are real verifiable votes.
+    for cs in commit.commits:
+        vote = cs.to_vote()
+        _, val = vote_set.val_set.get_by_address(vote.validator_address)
+        assert vote.verify(CHAIN_ID, val.pub_key) is None
+
+
+def test_add_verified_matches_add_vote_decisions():
+    # The device-batch path (verify in batch, then add_verified_vote) must make
+    # identical decisions to the scalar add_vote path.
+    vote_set_a, _, pvs, tx = rand_vote_set(7)
+    vote_set_b = TxVoteSet(
+        CHAIN_ID, 1, tx_hash(tx), tx_key(tx), vote_set_a.val_set
+    )
+    votes = [signed_vote(pv, tx) for pv in pvs]
+    votes += [votes[0].copy()]  # duplicate
+    for v in votes:
+        added_a, err_a = vote_set_a.add_vote(v)
+        # Simulate the batched path: signature pre-verified.
+        _, val = vote_set_b.val_set.get_by_address(v.validator_address)
+        assert v.verify(CHAIN_ID, val.pub_key) is None
+        added_b, err_b = vote_set_b.add_verified_vote(v)
+        assert added_a == added_b
+        assert (err_a is None) == (err_b is None)
+    assert vote_set_a.stake() == vote_set_b.stake()
+    assert vote_set_a.has_two_thirds_majority() == vote_set_b.has_two_thirds_majority()
+
+
+def test_quorum_accessor_quirks():
+    # total_stake() mirrors the reference's odd 2/3-of-total return.
+    vote_set, val_set, pvs, tx = rand_vote_set(10)
+    assert vote_set.total_stake() == val_set.total_voting_power() * 2 // 3
+    for pv in pvs:
+        vote_set.add_vote(signed_vote(pv, tx))
+    assert vote_set.has_all()

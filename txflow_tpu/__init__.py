@@ -1,0 +1,33 @@
+"""txflow_tpu — a TPU-native aBFT consensus framework.
+
+A brand-new framework with the capabilities of Fantom-foundation/go-txflow:
+per-transaction commit via asynchronous validator vote aggregation (>2/3 of
+stake), with a Tendermint-style block ticker as time-ordering fallback.
+
+The hot path — ed25519 signature verification plus stake-weighted quorum
+tally for thousands of in-flight transactions — runs as batched JAX/XLA
+device kernels behind a ``VoteVerifier`` interface (see
+``txflow_tpu.verifier``), instead of the reference's one-vote-at-a-time CPU
+loop (reference: txflow/service.go:123-166 -> types/vote_set.go:81-131).
+
+Layer map (mirrors SURVEY.md section 1):
+
+- ``codec``     amino-compatible canonical binary encoding (types/codec.go)
+- ``crypto``    host ed25519 + hashing/addresses (tendermint crypto)
+- ``types``     TxVote/TxVoteSet/Commit/Block/ValidatorSet (types/)
+- ``ops``       device kernels: GF(2^255-19) field, curve, batch verify, tally
+- ``verifier``  VoteVerifier interface: scalar golden model + device impl
+- ``parallel``  mesh/sharding of the vote-batch axis (shard_map/pjit)
+- ``pool``      mempool + txvotepool (mempool/, txvotepool/)
+- ``engine``    TxFlow aggregation service + TxExecutor (txflow/, txflowstate/)
+- ``abci``      application interface + example apps (kvstore, counter)
+- ``store``     tx/block/state stores over a KV DB (tx/, store/, state/store.go)
+- ``state``     replicated chain state + BlockExecutor (state/)
+- ``privval``   file-based signer with last-sign-state (privval/)
+- ``consensus`` block-path BFT state machine + WAL replay (consensus/)
+- ``net``       gossip transport: in-proc switch + reactors (p2p layer)
+- ``node``      composition root (node/node.go)
+- ``utils``     WAL, config, metrics, logging
+"""
+
+__version__ = "0.1.0"

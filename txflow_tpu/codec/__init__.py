@@ -1,0 +1,23 @@
+from .amino import (
+    AminoReader,
+    TYP3_8BYTE,
+    TYP3_BYTELEN,
+    TYP3_VARINT,
+    encode_time_body,
+    field_key,
+    read_uvarint,
+    uvarint,
+    varint,
+)
+
+__all__ = [
+    "AminoReader",
+    "TYP3_8BYTE",
+    "TYP3_BYTELEN",
+    "TYP3_VARINT",
+    "encode_time_body",
+    "field_key",
+    "read_uvarint",
+    "uvarint",
+    "varint",
+]

@@ -1,0 +1,155 @@
+"""TxVote: a per-transaction validator vote (reference types/tx_vote.go).
+
+Sign bytes are amino ``MarshalBinaryLengthPrefixed(CanonicalTxVote)`` where
+``CanonicalTxVote{Height fixed64, TxHash, TxKey, Timestamp, ChainID}`` — and,
+exactly as in the reference, ``CanonicalizeTxVote`` does NOT copy the vote's
+TxKey (types/tx_vote.go:185-192), so field 3 always serializes as 32 zero
+bytes. Preserving that quirk is required for signature compatibility.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field, replace
+
+from ..codec import amino
+from ..crypto import ed25519
+from ..crypto.hash import ADDRESS_SIZE, address_hash, sha256
+
+# Maximum amino-encoded vote size, including overhead (types/tx_vote.go:17).
+MAX_VOTE_BYTES = 223
+# tendermint types.MaxSignatureSize (v0.31).
+MAX_SIGNATURE_SIZE = 64
+
+_ZERO_TXKEY = bytes(32)
+
+
+def canonical_sign_bytes(
+    chain_id: str, height: int, tx_hash: str, timestamp_ns: int
+) -> bytes:
+    """Length-prefixed amino encoding of CanonicalTxVote."""
+    body = bytearray()
+    if height != 0:
+        body += amino.field_key(1, amino.TYP3_8BYTE)
+        body += amino.fixed64(height)
+    if tx_hash:
+        body += amino.field_key(2, amino.TYP3_BYTELEN)
+        body += amino.length_prefixed(tx_hash.encode())
+    # TxKey: fixed-size array, never elided; canonicalization leaves it zero.
+    body += amino.field_key(3, amino.TYP3_BYTELEN)
+    body += amino.length_prefixed(_ZERO_TXKEY)
+    ts_body = amino.encode_time_body(timestamp_ns)
+    if ts_body:
+        body += amino.field_key(4, amino.TYP3_BYTELEN)
+        body += amino.length_prefixed(ts_body)
+    if chain_id:
+        body += amino.field_key(5, amino.TYP3_BYTELEN)
+        body += amino.length_prefixed(chain_id.encode())
+    return amino.length_prefixed(bytes(body))
+
+
+@dataclass
+class TxVote:
+    height: int
+    tx_hash: str  # uppercase hex of sha256(tx)
+    tx_key: bytes  # sha256(tx), 32 bytes
+    timestamp_ns: int = field(default_factory=_time.time_ns)
+    validator_address: bytes = b""
+    signature: bytes | None = None
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return canonical_sign_bytes(
+            chain_id, self.height, self.tx_hash, self.timestamp_ns
+        )
+
+    def verify(self, chain_id: str, pub_key: bytes) -> str | None:
+        """Returns None if valid, else an error string (types/tx_vote.go:110-119)."""
+        if address_hash(pub_key) != self.validator_address:
+            return "invalid validator address"
+        if not self.signature or not ed25519.verify(
+            pub_key, self.sign_bytes(chain_id), self.signature
+        ):
+            return "invalid signature"
+        return None
+
+    def validate_basic(self) -> str | None:
+        if self.height < 0:
+            return "negative height"
+        if len(self.validator_address) != ADDRESS_SIZE:
+            return (
+                f"expected ValidatorAddress size to be {ADDRESS_SIZE} bytes, "
+                f"got {len(self.validator_address)} bytes"
+            )
+        if not self.signature:
+            return "signature is missing"
+        if len(self.signature) > MAX_SIGNATURE_SIZE:
+            return f"signature is too big (max: {MAX_SIGNATURE_SIZE})"
+        return None
+
+    def size(self) -> int:
+        return len(encode_tx_vote(self))
+
+    def copy(self) -> "TxVote":
+        return replace(self)
+
+    def vote_key(self) -> bytes:
+        """sha256(signature) — dedup cache key (txvotepool/txvotepool.go:467-469)."""
+        return sha256(self.signature or b"")
+
+
+def encode_tx_vote(vote: TxVote) -> bytes:
+    """Amino MarshalBinaryBare of the full TxVote struct (WAL/wire form)."""
+    body = bytearray()
+    if vote.height != 0:
+        body += amino.field_key(1, amino.TYP3_VARINT)
+        body += amino.varint(vote.height)
+    if vote.tx_hash:
+        body += amino.field_key(2, amino.TYP3_BYTELEN)
+        body += amino.length_prefixed(vote.tx_hash.encode())
+    body += amino.field_key(3, amino.TYP3_BYTELEN)
+    body += amino.length_prefixed(vote.tx_key or _ZERO_TXKEY)
+    ts_body = amino.encode_time_body(vote.timestamp_ns)
+    if ts_body:
+        body += amino.field_key(4, amino.TYP3_BYTELEN)
+        body += amino.length_prefixed(ts_body)
+    if vote.validator_address:
+        body += amino.field_key(5, amino.TYP3_BYTELEN)
+        body += amino.length_prefixed(vote.validator_address)
+    if vote.signature:
+        body += amino.field_key(6, amino.TYP3_BYTELEN)
+        body += amino.length_prefixed(vote.signature)
+    return bytes(body)
+
+
+def decode_tx_vote(data: bytes) -> TxVote:
+    r = amino.AminoReader(data)
+    height = 0
+    tx_hash = ""
+    tx_key = _ZERO_TXKEY
+    timestamp_ns = 0
+    validator_address = b""
+    signature = None
+    while not r.eof():
+        fnum, typ3 = r.read_field_key()
+        if fnum == 1 and typ3 == amino.TYP3_VARINT:
+            height = r.read_varint()
+        elif fnum == 2 and typ3 == amino.TYP3_BYTELEN:
+            tx_hash = r.read_bytes().decode()
+        elif fnum == 3 and typ3 == amino.TYP3_BYTELEN:
+            tx_key = r.read_bytes()
+        elif fnum == 4 and typ3 == amino.TYP3_BYTELEN:
+            timestamp_ns = amino.decode_time_body(r.read_bytes())
+        elif fnum == 5 and typ3 == amino.TYP3_BYTELEN:
+            validator_address = r.read_bytes()
+        elif fnum == 6 and typ3 == amino.TYP3_BYTELEN:
+            signature = r.read_bytes()
+        else:
+            r.skip_field(typ3)
+    return TxVote(
+        height=height,
+        tx_hash=tx_hash,
+        tx_key=tx_key,
+        timestamp_ns=timestamp_ns,
+        validator_address=validator_address,
+        signature=signature,
+    )

@@ -1,0 +1,128 @@
+"""Validator and ValidatorSet (tendermint v0.31 types, the subset TxFlow uses).
+
+The vote-set quorum math keys off ``GetByAddress`` and ``TotalVotingPower``
+(reference types/vote_set.go:102, :158). The set is kept sorted by address
+ascending, as upstream does, and additionally maintains dense device-side
+arrays (pubkeys, powers) so a validator set can be uploaded once per epoch
+and indexed by integer validator id inside the batched verifier.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..crypto.hash import address_hash
+
+
+@dataclass
+class Validator:
+    address: bytes
+    pub_key: bytes  # ed25519, 32 bytes
+    voting_power: int
+    proposer_priority: int = 0
+
+    @classmethod
+    def from_pub_key(cls, pub_key: bytes, voting_power: int) -> "Validator":
+        return cls(address_hash(pub_key), pub_key, voting_power)
+
+    def copy(self) -> "Validator":
+        return Validator(
+            self.address, self.pub_key, self.voting_power, self.proposer_priority
+        )
+
+    def compare_proposer_priority(self, other: "Validator") -> "Validator":
+        """The one with higher priority wins; ties break by lower address."""
+        if self.proposer_priority > other.proposer_priority:
+            return self
+        if self.proposer_priority < other.proposer_priority:
+            return other
+        return self if self.address < other.address else other
+
+
+class ValidatorSet:
+    def __init__(self, validators: list[Validator]):
+        self.validators: list[Validator] = sorted(
+            (v.copy() for v in validators), key=lambda v: v.address
+        )
+        self._by_address = {v.address: i for i, v in enumerate(self.validators)}
+        if len(self._by_address) != len(self.validators):
+            raise ValueError("duplicate validator address")
+        self._total_voting_power = sum(v.voting_power for v in self.validators)
+        # Dense device-friendly views, built lazily.
+        self._pub_keys_np: np.ndarray | None = None
+        self._powers_np: np.ndarray | None = None
+
+    def size(self) -> int:
+        return len(self.validators)
+
+    def total_voting_power(self) -> int:
+        return self._total_voting_power
+
+    def quorum_power(self) -> int:
+        """The 2/3+1 stake threshold (types/vote_set.go:158)."""
+        return self._total_voting_power * 2 // 3 + 1
+
+    def has_address(self, address: bytes) -> bool:
+        return address in self._by_address
+
+    def get_by_address(self, address: bytes) -> tuple[int, Validator | None]:
+        idx = self._by_address.get(address)
+        if idx is None:
+            return -1, None
+        return idx, self.validators[idx]
+
+    def get_by_index(self, idx: int) -> Validator | None:
+        if 0 <= idx < len(self.validators):
+            return self.validators[idx]
+        return None
+
+    def index_of(self, address: bytes) -> int:
+        return self._by_address.get(address, -1)
+
+    def copy(self) -> "ValidatorSet":
+        vs = ValidatorSet(self.validators)
+        return vs
+
+    def pub_keys_array(self) -> np.ndarray:
+        """(n, 32) uint8 array of compressed pubkeys, validator-index order."""
+        if self._pub_keys_np is None:
+            self._pub_keys_np = np.frombuffer(
+                b"".join(v.pub_key for v in self.validators), dtype=np.uint8
+            ).reshape(len(self.validators), 32)
+        return self._pub_keys_np
+
+    def powers_array(self) -> np.ndarray:
+        """(n,) int64 voting powers, validator-index order."""
+        if self._powers_np is None:
+            self._powers_np = np.array(
+                [v.voting_power for v in self.validators], dtype=np.int64
+            )
+        return self._powers_np
+
+    def __iter__(self):
+        return iter(self.validators)
+
+    def __len__(self) -> int:
+        return len(self.validators)
+
+    # ---- proposer rotation (consensus block path) ----
+
+    def get_proposer(self) -> Validator:
+        if not self.validators:
+            raise ValueError("empty validator set")
+        best = self.validators[0]
+        for v in self.validators[1:]:
+            best = best.compare_proposer_priority(v)
+        return best
+
+    def increment_proposer_priority(self, times: int = 1) -> "ValidatorSet":
+        """Tendermint's round-robin-by-stake rotation (state/execution upstream)."""
+        vs = self.copy()
+        for _ in range(times):
+            for v in vs.validators:
+                v.proposer_priority += v.voting_power
+            proposer = vs.get_proposer()
+            proposer.proposer_priority -= vs._total_voting_power
+        return vs
