@@ -1,0 +1,108 @@
+"""Field arithmetic golden tests: limb ops vs python-int arithmetic."""
+
+import random
+
+import numpy as np
+import pytest
+
+from txflow_tpu.crypto.ed25519 import P
+from txflow_tpu.ops import fe
+
+rng = random.Random(0xFE)
+
+
+def rand_fe(n):
+    return [rng.randrange(P) for _ in range(n)]
+
+
+def to_limb_batch(vals):
+    return np.stack([fe.int_to_limbs(v) for v in vals])
+
+
+EDGE = [0, 1, 2, 19, 38, P - 1, P - 2, 2**255 - 1, 2**254, 0xFF, 1 << 248]
+
+
+def check_normalized(out):
+    out = np.asarray(out)
+    assert out.min() >= 0
+    assert out.max() < 512, out.max()
+
+
+def test_roundtrip():
+    for v in EDGE + rand_fe(16):
+        assert fe.limbs_to_int(fe.int_to_limbs(v)) == v
+
+
+def test_mul():
+    a_vals = EDGE + rand_fe(32)
+    b_vals = list(reversed(EDGE)) + rand_fe(32)
+    out = fe.fe_mul(to_limb_batch(a_vals), to_limb_batch(b_vals))
+    check_normalized(out)
+    for av, bv, o in zip(a_vals, b_vals, np.asarray(out)):
+        assert fe.limbs_to_int(o) % P == (av * bv) % P
+
+
+def test_mul_worst_case_bounds():
+    # Max legal input limbs (1311) must not overflow int32 anywhere.
+    a = np.full((1, fe.NLIMB), 1311, np.int32)
+    out = np.asarray(fe.fe_mul(a, a))
+    check_normalized(out)
+    assert fe.limbs_to_int(out[0]) % P == (fe.limbs_to_int(a[0]) ** 2) % P
+
+
+def test_add_sub():
+    a_vals, b_vals = rand_fe(16), rand_fe(16)
+    a, b = to_limb_batch(a_vals), to_limb_batch(b_vals)
+    s = fe.fe_sub(a, b)
+    check_normalized(s)
+    for av, bv, o in zip(a_vals, b_vals, np.asarray(s)):
+        assert fe.limbs_to_int(o) % P == (av - bv) % P
+    # add -> mul composition (documented bound path)
+    m = fe.fe_mul(fe.fe_add(a, b), fe.fe_add(b, a))
+    check_normalized(m)
+    for av, bv, o in zip(a_vals, b_vals, np.asarray(m)):
+        assert fe.limbs_to_int(o) % P == ((av + bv) ** 2) % P
+
+
+def test_mul_small():
+    a_vals = rand_fe(8) + EDGE
+    out = fe.fe_mul_small(to_limb_batch(a_vals), 121666)
+    check_normalized(out)
+    for av, o in zip(a_vals, np.asarray(out)):
+        assert fe.limbs_to_int(o) % P == (av * 121666) % P
+
+
+def test_freeze_canonical():
+    # Non-canonical representations of known values must freeze exactly.
+    cases = []
+    for v in [0, 1, 19, P - 1, P - 2]:
+        cases.append((fe.int_to_limbs(v), v))
+    cases.append((fe.P_LIMBS.copy(), 0))  # p ≡ 0
+    p_plus_1 = fe.P_LIMBS.copy()
+    p_plus_1[0] += 1
+    cases.append((p_plus_1, 1))
+    cases.append((2 * fe.P_LIMBS + fe.int_to_limbs(5), 5))  # 2p + 5
+    big = np.full(fe.NLIMB, 511, np.int32)  # arbitrary non-canonical
+    cases.append((big, fe.limbs_to_int(big) % P))
+    arr = np.stack([c[0] for c in cases])
+    out = np.asarray(fe.fe_freeze(arr))
+    assert out.min() >= 0 and out.max() <= 255
+    for (_, want), o in zip(cases, out):
+        assert fe.limbs_to_int(o) == want
+
+
+def test_inv():
+    vals = [v for v in EDGE if v % P != 0] + rand_fe(8)
+    out = np.asarray(fe.fe_inv(to_limb_batch(vals)))
+    check_normalized(out)
+    for v, o in zip(vals, out):
+        assert fe.limbs_to_int(o) % P == pow(v, P - 2, P)
+
+
+@pytest.mark.parametrize("value", [2**31 - 1])
+def test_carry_extreme(value):
+    # fe_carry must settle the largest fold outputs into normalized limbs.
+    x = np.full((1, fe.NLIMB), value, np.int32)
+    out = np.asarray(fe.fe_carry(x, passes=6))
+    check_normalized(out)
+    assert fe.limbs_to_int(out[0]) % P == fe.limbs_to_int(x[0]) % P

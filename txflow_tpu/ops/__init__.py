@@ -1,0 +1,3 @@
+"""Device kernels: batched field/curve arithmetic, ed25519 verify, stake tally."""
+
+from . import curve, ed25519_batch, fe  # noqa: F401

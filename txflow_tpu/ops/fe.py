@@ -1,0 +1,174 @@
+"""Batched GF(2^255-19) field arithmetic in radix-2^8 int32 limbs.
+
+TPU-first bignum design (replaces nothing in the reference — go-txflow does all
+ed25519 math one signature at a time on CPU via Go's crypto/ed25519,
+types/tx_vote.go:110-119):
+
+- A field element is an int32 tensor ``[..., 32]`` of little-endian radix-256
+  limbs. All ops are elementwise/vectorized over the leading batch dims — no
+  data-dependent control flow, so the whole verifier jits into one XLA program
+  and shards over a device mesh with ``shard_map``.
+- Radix 2^8 keeps every partial product <= 255*255 < 2^16 and every column sum
+  of a 32x32 limb convolution <= 32*2^16 < 2^21, far inside int32 — and inside
+  float32's 2^24 exact-integer window, so the inner convolution can later be
+  lowered to an MXU f32 matmul or a pallas kernel without changing semantics.
+- Carry propagation is a few data-parallel passes (no sequential limb scan);
+  only the final canonical freeze (needed once per verify, for the
+  encode(P) == R byte comparison Go does) uses an exact borrow scan.
+
+Bounds discipline (checked by tests/test_fe.py):
+- "normalized": limbs in [0, 512)   — output of fe_carry/fe_mul/fe_sub.
+- fe_mul/fe_sq inputs must have limbs in [0, 1311]; sums of two normalized
+  values (fe_add output, <= 1024) are therefore legal mul inputs.
+- fe_sub(a, b) requires b limbs <= 2040 (it adds the limbwise constant 8*p).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+NLIMB = 32
+RADIX = 8
+MASK = (1 << RADIX) - 1
+
+# p = 2^255 - 19, little-endian radix-256 limbs.
+P_INT = 2**255 - 19
+P_LIMBS = np.array([0xED] + [0xFF] * 30 + [0x7F], dtype=np.int32)
+# Limbwise 8*p: a value ≡ 0 (mod p) that dominates any subtrahend with
+# limbs <= 2040, making limbwise subtraction borrow-free.
+EIGHT_P_LIMBS = 8 * P_LIMBS
+
+# Anti-diagonal gather plan for the 32x32 limb product: column k of the
+# product accumulates a[i] * b[k-i]; _IDX/_VALID pre-encode the k-i map.
+_K = np.arange(2 * NLIMB - 1)
+_I = np.arange(NLIMB)
+_IDX = np.clip(_K[None, :] - _I[:, None], 0, NLIMB - 1)  # [32, 63]
+_VALID = (_K[None, :] - _I[:, None] >= 0) & (_K[None, :] - _I[:, None] < NLIMB)
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Host helper: python int -> canonical limb vector."""
+    return np.array([(x >> (RADIX * i)) & MASK for i in range(NLIMB)], dtype=np.int32)
+
+
+def limbs_to_int(limbs) -> int:
+    """Host helper: limb vector (any bounds) -> python int."""
+    out = 0
+    for i, v in enumerate(np.asarray(limbs).tolist()):
+        out += int(v) << (RADIX * i)
+    return out
+
+
+def bytes_to_limbs(b: bytes) -> np.ndarray:
+    assert len(b) == 32
+    return np.frombuffer(b, dtype=np.uint8).astype(np.int32)
+
+
+def fe_carry(x, passes: int = 4):
+    """Data-parallel carry with 2^256 ≡ 38 wraparound.
+
+    Each pass moves carries one limb up; the carry out of limb 31 re-enters
+    limb 0 scaled by 38. For inputs bounded by 2^29 (worst case out of the
+    fe_mul fold) four passes bring every limb under 512.
+    """
+    for _ in range(passes):
+        hi = x >> RADIX
+        lo = x & MASK
+        wrapped = jnp.concatenate([38 * hi[..., NLIMB - 1 :], hi[..., : NLIMB - 1]], axis=-1)
+        x = lo + wrapped
+    return x
+
+
+def fe_add(a, b):
+    """Limbwise add; output limbs <= 1024 when inputs are normalized."""
+    return a + b
+
+
+def fe_sub(a, b):
+    """a - b mod p, borrow-free via the 8p offset; output normalized."""
+    return fe_carry(a + jnp.asarray(EIGHT_P_LIMBS) - b, passes=2)
+
+
+def fe_mul(a, b):
+    """Product mod 2^255-19 (normalized limbs). Inputs: limbs <= 1311.
+
+    32x32 limb convolution via a static anti-diagonal gather, then the
+    2^256 ≡ 38 fold of the high 31 columns, then carries. The einsum is the
+    hot op of the whole framework — a batched [B,32]x[B,32,63] contraction
+    XLA maps onto the TPU VPU (or, via the f32 path, the MXU).
+    """
+    bsh = jnp.where(jnp.asarray(_VALID), b[..., jnp.asarray(_IDX)], 0)  # [..., 32, 63]
+    c = jnp.einsum("...i,...ik->...k", a, bsh)  # [..., 63]
+    hi = jnp.pad(c[..., NLIMB:], [(0, 0)] * (c.ndim - 1) + [(0, 1)])
+    # Worst legal input (limbs 1311) folds to < 2^31; five carry passes are
+    # needed for the big limb-0 carry to fully settle (it moves up one limb
+    # per pass: 0 -> 1 -> 2 -> 3 -> done).
+    return fe_carry(c[..., :NLIMB] + 38 * hi, passes=5)
+
+
+def fe_sq(a):
+    return fe_mul(a, a)
+
+
+def fe_mul_small(a, c: int):
+    """Multiply by a small scalar constant (c <= ~2^20); output normalized."""
+    return fe_carry(a * c)
+
+
+def fe_freeze(x):
+    """Exact canonical reduction: limbs in [0,256) and value < p.
+
+    Used once per verification for the byte-exact encode(P) == sig[:32]
+    comparison (Go compares encodings, never decompressing R). Two borrow
+    scans subtract p at most twice: after carrying, the value is < 2^256 =
+    2p + 38, so two conditional subtractions always land in [0, p).
+    """
+    x = fe_carry(x, passes=6)  # limbs <= ~293, value < 2^256
+    p = jnp.asarray(P_LIMBS)
+    for _ in range(2):
+        # Exact x - p with sequential borrow (31 cheap steps, once per verify).
+        diff = x - p
+        borrows = []
+        borrow = jnp.zeros_like(x[..., 0])
+        for i in range(NLIMB):
+            d = diff[..., i] - borrow
+            borrow = (d < 0).astype(x.dtype)
+            borrows.append(d + (borrow << RADIX))
+        sub = jnp.stack(borrows, axis=-1)
+        x = jnp.where((borrow == 0)[..., None], sub, x)
+    # Final carry normalization to strict [0, 256) limbs.
+    return fe_carry(x, passes=2)
+
+
+def fe_is_equal_frozen(a, b):
+    """Bytewise equality of two frozen elements -> bool[...]."""
+    return jnp.all(a == b, axis=-1)
+
+
+def fe_parity_frozen(a):
+    """Low bit of a frozen element (the encode() sign source)."""
+    return a[..., 0] & 1
+
+
+def fe_inv(a):
+    """a^(p-2) via the standard 25519 addition chain (~254 sq + 11 mul)."""
+
+    def pow2k(x, k):
+        for _ in range(k):
+            x = fe_sq(x)
+        return x
+
+    z2 = fe_sq(a)  # 2
+    z9 = fe_mul(pow2k(z2, 2), a)  # 9
+    z11 = fe_mul(z9, z2)  # 11
+    z2_5_0 = fe_mul(fe_sq(z11), z9)  # 2^5 - 2^0
+    z2_10_0 = fe_mul(pow2k(z2_5_0, 5), z2_5_0)
+    z2_20_0 = fe_mul(pow2k(z2_10_0, 10), z2_10_0)
+    z2_40_0 = fe_mul(pow2k(z2_20_0, 20), z2_20_0)
+    z2_50_0 = fe_mul(pow2k(z2_40_0, 10), z2_10_0)
+    z2_100_0 = fe_mul(pow2k(z2_50_0, 50), z2_50_0)
+    z2_200_0 = fe_mul(pow2k(z2_100_0, 100), z2_100_0)
+    z2_250_0 = fe_mul(pow2k(z2_200_0, 50), z2_50_0)
+    return fe_mul(pow2k(z2_250_0, 5), z11)  # 2^255 - 21

@@ -1,0 +1,59 @@
+"""Stake-weighted quorum tally as a device reduction.
+
+The reference accumulates voting power one vote at a time under a mutex
+(types/vote_set.go:143-166: ``sum += power; maj23 = sum >= total*2/3+1``).
+Here the tally over a whole batch of verified votes is a segment-sum over
+tx slots followed by a threshold compare — one fused XLA reduction, and the
+cross-device combine is a single ``psum`` over the vote-sharding mesh axis.
+
+Voting powers are int64 in the reference. The device tally uses int32 —
+sufficient whenever total voting power < 2^31, which the engine checks at
+epoch build time and otherwise rescales (the quorum decision is invariant
+under proportional scaling only approximately, so instead the engine falls
+back to host-side int64 accumulation for such sets; tendermint itself caps
+total power at 2^63/8, and practical validator sets are far below 2^31).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def tally_kernel(valid, tx_slot, power, n_slots: int):
+    """Per-slot stake sums for one device shard.
+
+    valid: bool[B] (verified signatures), tx_slot: int32[B] slot id per vote
+    (-1 or >= n_slots = no slot / padding), power: int32[B] voting power of
+    the vote's validator. Returns int32[n_slots].
+    """
+    contrib = jnp.where(valid, power, 0)
+    slot = jnp.clip(tx_slot, 0, n_slots - 1)
+    in_range = (tx_slot >= 0) & (tx_slot < n_slots)
+    return jax.ops.segment_sum(
+        jnp.where(in_range, contrib, 0), slot, num_segments=n_slots
+    )
+
+
+def verify_and_tally(verify_fn, axis_name: str | None = None):
+    """Compose a verify kernel with the quorum tally.
+
+    Returns f(verify_inputs..., tx_slot, power, prior_stake, quorum) ->
+    (valid[B], stake[n_slots], maj23[n_slots]).
+
+    prior_stake carries stake already accumulated for each slot in earlier
+    batches (the engine's running TxVoteSet sums), so maj23 latches across
+    batches exactly like the incremental reference. When ``axis_name`` is
+    given the stake partial-sums are psum-combined across the vote-sharded
+    mesh axis (ICI collective), giving every shard the global tally.
+    """
+
+    def f(verify_inputs, tx_slot, power, prior_stake, quorum):
+        valid = verify_fn(*verify_inputs)
+        stake = tally_kernel(valid, tx_slot, power, prior_stake.shape[0])
+        if axis_name is not None:
+            stake = jax.lax.psum(stake, axis_name)
+        total = prior_stake + stake
+        return valid, total, total >= quorum
+
+    return f

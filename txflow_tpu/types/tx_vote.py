@@ -137,6 +137,12 @@ def decode_tx_vote(data: bytes) -> TxVote:
             tx_hash = r.read_bytes().decode()
         elif fnum == 3 and typ3 == amino.TYP3_BYTELEN:
             tx_key = r.read_bytes()
+            if len(tx_key) != 32:
+                # Go amino unmarshals into [sha256.Size]byte and errors on
+                # any other length; keep the wire accept-set identical.
+                raise ValueError(
+                    f"TxKey must be 32 bytes, got {len(tx_key)}"
+                )
         elif fnum == 4 and typ3 == amino.TYP3_BYTELEN:
             timestamp_ns = amino.decode_time_body(r.read_bytes())
         elif fnum == 5 and typ3 == amino.TYP3_BYTELEN:

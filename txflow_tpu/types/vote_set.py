@@ -116,8 +116,10 @@ class TxVoteSet:
         return self.val_set.size()
 
     def get_votes(self) -> list[TxVote]:
+        # Copies, like the reference's by-value GetVotes — callers must not
+        # be able to mutate the stored votes (first-sig-wins state).
         with self._mtx:
-            return list(self.votes.values())
+            return [v.copy() for v in self.votes.values()]
 
     def get_by_address(self, address: bytes) -> TxVote | None:
         with self._mtx:
@@ -154,7 +156,12 @@ class TxVoteSet:
         with self._mtx:
             return self._add_vote(vote)
 
-    def _add_vote(self, vote: TxVote | None) -> tuple[bool, Exception | None]:
+    def _add_vote(
+        self, vote: TxVote | None, check_signature: bool = True
+    ) -> tuple[bool, Exception | None]:
+        """One shared decision path for both the scalar and device routes:
+        the batch-verified route is identical minus the signature check, so
+        parity between the two can never drift."""
         if vote is None:
             return False, ErrVoteNil()
         if len(vote.validator_address) == 0:
@@ -172,40 +179,19 @@ class TxVoteSet:
             return False, ErrVoteNonDeterministicSignature(
                 f"existing vote: {existing}; new vote: {vote}"
             )
-        err = vote.verify(self.chain_id, val.pub_key)
-        if err is not None:
-            return False, ErrVoteInvalidSignature(
-                f"failed to verify vote with ChainID {self.chain_id}: {err}"
-            )
+        if check_signature:
+            err = vote.verify(self.chain_id, val.pub_key)
+            if err is not None:
+                return False, ErrVoteInvalidSignature(
+                    f"failed to verify vote with ChainID {self.chain_id}: {err}"
+                )
         self._add_verified(vote, val.voting_power)
         return True, None
 
     def add_verified_vote(self, vote: TxVote) -> tuple[bool, Exception | None]:
-        """Add a vote whose signature was already verified (device batch path).
-
-        Performs the same membership/duplicate/first-sig-wins decisions as
-        ``add_vote`` minus the signature check, so batched verification +
-        this call is decision-identical to the scalar path.
-        """
+        """Add a vote whose signature was already verified (device batch path)."""
         with self._mtx:
-            if vote is None:
-                return False, ErrVoteNil()
-            if len(vote.validator_address) == 0:
-                return False, ErrVoteInvalidValidatorAddress("empty address")
-            _, val = self.val_set.get_by_address(vote.validator_address)
-            if val is None:
-                return False, ErrVoteInvalidValidatorIndex(
-                    f"cannot find validator {vote.validator_address.hex().upper()}"
-                )
-            existing = self.votes.get(vote.validator_address)
-            if existing is not None:
-                if existing.signature == vote.signature:
-                    return False, None
-                return False, ErrVoteNonDeterministicSignature(
-                    f"existing vote: {existing}; new vote: {vote}"
-                )
-            self._add_verified(vote, val.voting_power)
-            return True, None
+            return self._add_vote(vote, check_signature=False)
 
     def _add_verified(self, vote: TxVote, voting_power: int) -> None:
         self.votes[vote.validator_address] = vote
