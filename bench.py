@@ -1,0 +1,87 @@
+"""North-star benchmark: committed TxVotes/sec through the batched verifier.
+
+Measurement protocol per BASELINE.json config 1-2: a 4-validator set,
+pregenerated signed TxVotes (4 votes per tx — every commit decision needs
+a full honest quorum at equal stake: quorum = floor(40*2/3)+1 = 27 > 3*10),
+replayed through the device verify+tally path in fixed-size batches. The
+measured rate counts verified-and-tallied votes per second of sustained
+wall-clock, including per-batch host prep (sig parsing, SHA-512 folding,
+scalar decomposition, table gather) and the D2H readback of the
+valid/stake/maj23 masks — i.e. everything between "votes in the pool" and
+"quorum decision on host".
+
+Baseline: the reference's hot path is one pure-Go ed25519 verify per vote,
+single-threaded (reference txflow/service.go:123-166, ~50-100us/verify =>
+~10-20k votes/s/core; BASELINE.md). vs_baseline is measured against the
+generous end of that ceiling, 20,000 votes/s.
+
+Prints exactly one JSON line.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_VOTES_PER_SEC = 20_000.0  # reference CPU ceiling, BASELINE.md
+CHAIN_ID = "txflow-bench"
+
+
+def main():
+    from txflow_tpu.crypto import ed25519 as host_ed
+    from txflow_tpu.types import Validator, ValidatorSet, canonical_sign_bytes
+    from txflow_tpu.verifier import DeviceVoteVerifier
+
+    n_vals = int(os.environ.get("BENCH_VALIDATORS", "4"))
+    batch = int(os.environ.get("BENCH_BATCH", "4096"))
+    iters = int(os.environ.get("BENCH_ITERS", "8"))
+
+    seeds = [hashlib.sha256(b"bench-val%d" % i).digest() for i in range(n_vals)]
+    pubs = [host_ed.public_key_from_seed(s) for s in seeds]
+    vals = ValidatorSet([Validator.from_pub_key(p, 10) for p in pubs])
+    seed_by_index = [dict(zip(pubs, seeds))[v.pub_key] for v in vals]
+
+    n_txs = batch // n_vals
+    msgs, sigs, vidx, slot = [], [], [], []
+    for t in range(n_txs):
+        tx_hash = hashlib.sha256(b"bench-tx%d" % t).hexdigest().upper()
+        msg = canonical_sign_bytes(CHAIN_ID, 1, tx_hash, 1700000000_000000000 + t)
+        for vi in range(n_vals):
+            msgs.append(msg)
+            sigs.append(host_ed.sign(seed_by_index[vi], msg))
+            vidx.append(vi)
+            slot.append(t)
+    vidx = np.array(vidx)
+    slot = np.array(slot, np.int32)
+
+    verifier = DeviceVoteVerifier(vals)
+
+    # warmup: compile + correctness gate (commit decisions must be unanimous)
+    r = verifier.verify_and_tally(msgs, sigs, vidx, slot, n_txs)
+    assert r.valid.all(), "bench corpus must verify"
+    assert r.maj23.all(), "full quorum expected on every tx"
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = verifier.verify_and_tally(msgs, sigs, vidx, slot, n_txs)
+        assert r.maj23.all()
+    dt = time.perf_counter() - t0
+
+    votes_per_sec = iters * len(msgs) / dt
+    print(
+        json.dumps(
+            {
+                "metric": "committed_txvotes_per_sec",
+                "value": round(votes_per_sec, 1),
+                "unit": "votes/s",
+                "vs_baseline": round(votes_per_sec / BASELINE_VOTES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

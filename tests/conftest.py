@@ -1,18 +1,30 @@
-"""Test configuration: force an 8-device virtual CPU mesh before JAX import.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
 Multi-chip hardware is not available in CI; sharding tests run against
 XLA's host-platform device-count override, per the project testing contract.
+
+Note: the environment's PJRT site hook may pre-register a TPU platform and
+pin ``jax_platforms`` before this file runs, so setting the ``JAX_PLATFORMS``
+env var is not sufficient — the config must be updated after jax import
+(and XLA_FLAGS must be in place before the CPU client is first created).
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    "test contract requires an 8-device virtual CPU mesh, got "
+    f"{jax.devices()}"
+)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

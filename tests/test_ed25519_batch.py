@@ -94,29 +94,34 @@ def test_off_curve_pubkey_rejected():
 
 
 def test_noncanonical_r_rejected():
-    # R encoding with y >= p: take a valid sig and add p to R's y part when
-    # possible without overflowing 255 bits -> Go rejects by byte compare.
+    """The kernel accepts only the exact canonical R encoding.
+
+    Every non-canonical 255-bit y encoding is y' = y + p for some y < 19, so
+    no forgeable signature can reach that branch end-to-end (R = sB - hA
+    would need to land on one of ~19 points); what must hold is (a) the
+    frozen-limb comparison distinguishes y from y + p, and (b) a flipped
+    x-sign bit on an otherwise-valid R is rejected end-to-end.
+    """
+    from txflow_tpu.ops import fe
+    import jax.numpy as jnp
+
+    # (a) direct: canonical limbs of y vs the non-canonical y + p encoding
+    for y_small in (0, 1, 5, 18):
+        canon = jnp.asarray(fe.int_to_limbs(y_small))[None]
+        noncanon = jnp.asarray(fe.int_to_limbs(y_small + host_ed.P))[None]
+        assert not bool(fe.fe_is_equal_frozen(canon, noncanon)[0])
+        assert bool(fe.fe_is_equal_frozen(canon, canon)[0])
+
+    # (b) end-to-end: same point, flipped canonical sign bit -> reject
     seeds, pubs = make_keys(1)
     epoch = eb.EpochTables(pubs)
     m = b"canonical"
     good = host_ed.sign(seeds[0], m)
     r_int = int.from_bytes(good[:32], "little")
-    y = r_int & ((1 << 255) - 1)
-    if y < 19:  # astronomically unlikely with fixed rng; guard anyway
-        return
-    # Forge R' = (y - p) + same sign bit: decompresses to the same point in
-    # Go's lenient FeFromBytes but differs bytewise -> must reject.
-    y_nc = y - host_ed.P + (1 << 255) if y - host_ed.P >= 0 else None
-    forged = []
-    if y_nc is not None:
-        forged.append(y_nc | (r_int >> 255) << 255)
-    # Always test: same point, flipped canonical sign bit.
-    forged.append(r_int ^ (1 << 255))
-    for f in forged:
-        sig = f.to_bytes(32, "little") + good[32:]
-        batch = eb.prepare_batch([m], [sig], np.array([0]), epoch)
-        assert eb.verify_batch(batch).tolist() == [False]
-        assert not host_ed.verify(pubs[0], m, sig)
+    sig = (r_int ^ (1 << 255)).to_bytes(32, "little") + good[32:]
+    batch = eb.prepare_batch([m], [sig], np.array([0]), epoch)
+    assert eb.verify_batch(batch).tolist() == [False]
+    assert not host_ed.verify(pubs[0], m, sig)
 
 
 def test_random_cross_check_mixed():

@@ -1,0 +1,149 @@
+"""VoteVerifier parity: device (and sharded-device) vs the scalar golden model.
+
+Mirrors the reference's quorum tests (types/vote_set_test.go) at the batch
+level, plus BASELINE config 4's adversarial mix: honest votes, corrupted
+signatures, wrong-key signatures, off-range validator indices, and padding.
+Commit decisions must be bit-identical across all three implementations.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from txflow_tpu.crypto import ed25519 as host_ed
+from txflow_tpu.parallel import make_mesh
+from txflow_tpu.types import TxVote, Validator, ValidatorSet, canonical_sign_bytes
+from txflow_tpu.verifier import (
+    DeviceVoteVerifier,
+    ScalarVoteVerifier,
+    bucket_size,
+)
+
+CHAIN_ID = "txflow-test"
+
+
+def make_valset(n, power=10):
+    seeds = [hashlib.sha256(b"val%d" % i).digest() for i in range(n)]
+    pubs = [host_ed.public_key_from_seed(s) for s in seeds]
+    vals = ValidatorSet([Validator.from_pub_key(p, power) for p in pubs])
+    # map validator order back to seeds (ValidatorSet sorts by address)
+    seed_by_pub = dict(zip(pubs, seeds))
+    return vals, [seed_by_pub[v.pub_key] for v in vals]
+
+
+def make_batch(vals, seeds, n_txs, corrupt=()):
+    """One vote per (tx, validator); corrupt[i] flavors in arrival order."""
+    msgs, sigs, vidx, slot = [], [], [], []
+    k = 0
+    for t in range(n_txs):
+        tx_hash = hashlib.sha256(b"tx%d" % t).hexdigest().upper()
+        tx_key = hashlib.sha256(b"key%d" % t).digest()
+        for vi in range(len(seeds)):
+            msg = canonical_sign_bytes(CHAIN_ID, 1, tx_hash, 1700000000_000000000 + t)
+            sig = host_ed.sign(seeds[vi], msg)
+            mode = corrupt[k % len(corrupt)] if corrupt else "ok"
+            if mode == "flip":
+                sig = sig[:10] + bytes([sig[10] ^ 1]) + sig[11:]
+            elif mode == "wrongkey":
+                sig = host_ed.sign(seeds[(vi + 1) % len(seeds)], msg)
+            elif mode == "badidx":
+                vidx.append(len(seeds) + 5)
+                msgs.append(msg), sigs.append(sig), slot.append(t)
+                k += 1
+                continue
+            msgs.append(msg), sigs.append(sig), vidx.append(vi), slot.append(t)
+            k += 1
+    return msgs, sigs, np.array(vidx), np.array(slot)
+
+
+@pytest.fixture(scope="module")
+def valset4():
+    return make_valset(4)
+
+
+def assert_parity(vals, msgs, sigs, vidx, slot, n_slots, prior=None):
+    scalar = ScalarVoteVerifier(vals)
+    device = DeviceVoteVerifier(vals)
+    r_s = scalar.verify_and_tally(msgs, sigs, vidx, slot, n_slots, prior)
+    r_d = device.verify_and_tally(msgs, sigs, vidx, slot, n_slots, prior)
+    np.testing.assert_array_equal(r_s.valid, r_d.valid)
+    np.testing.assert_array_equal(r_s.stake, r_d.stake.astype(np.int64))
+    np.testing.assert_array_equal(r_s.maj23, r_d.maj23)
+    np.testing.assert_array_equal(r_s.dropped, r_d.dropped)
+    return r_s
+
+
+def test_all_honest_quorum(valset4):
+    vals, seeds = valset4
+    msgs, sigs, vidx, slot = make_batch(vals, seeds, n_txs=3)
+    r = assert_parity(vals, msgs, sigs, vidx, slot, n_slots=3)
+    assert r.valid.all()
+    assert r.maj23.all()
+    assert (r.stake == vals.total_voting_power()).all()
+
+
+def test_adversarial_mix(valset4):
+    vals, seeds = valset4
+    msgs, sigs, vidx, slot = make_batch(
+        vals, seeds, n_txs=4, corrupt=("ok", "flip", "wrongkey", "badidx")
+    )
+    r = assert_parity(vals, msgs, sigs, vidx, slot, n_slots=4)
+    assert not r.valid.all() and r.valid.any()
+    # with only 1-2 of 4 honest votes per tx, no quorum anywhere
+    assert not r.maj23.any()
+
+
+def test_prior_stake_latches_quorum(valset4):
+    """Quorum accumulates across batches exactly like the incremental reference."""
+    vals, seeds = valset4
+    msgs, sigs, vidx, slot = make_batch(vals, seeds, n_txs=1)
+    # batch 1: two honest votes -> 20/40 stake, below quorum (27)
+    r1 = assert_parity(vals, msgs[:2], sigs[:2], vidx[:2], slot[:2], 1)
+    assert not r1.maj23[0] and r1.stake[0] == 20
+    # batch 2: one more vote on top of prior -> 30 >= 27
+    r2 = assert_parity(vals, msgs[2:3], sigs[2:3], vidx[2:3], slot[2:3], 1, prior=r1.stake)
+    assert r2.maj23[0] and r2.stake[0] == 30
+
+
+def test_sharded_matches_single_device(valset4):
+    vals, seeds = valset4
+    mesh = make_mesh(8)
+    msgs, sigs, vidx, slot = make_batch(
+        vals, seeds, n_txs=5, corrupt=("ok", "ok", "flip")
+    )
+    sharded = DeviceVoteVerifier(vals, mesh=mesh)
+    single = DeviceVoteVerifier(vals)
+    r_m = sharded.verify_and_tally(msgs, sigs, vidx, slot, 5)
+    r_1 = single.verify_and_tally(msgs, sigs, vidx, slot, 5)
+    np.testing.assert_array_equal(r_m.valid, r_1.valid)
+    np.testing.assert_array_equal(r_m.stake, r_1.stake)
+    np.testing.assert_array_equal(r_m.maj23, r_1.maj23)
+
+
+def test_replayed_vote_not_double_counted(valset4):
+    """A (tx, validator) pair repeated in one batch contributes power once.
+
+    The reference can never double-count one validator's stake
+    (first-signature-wins, types/vote_set.go:109-131); an adversary
+    replaying one honest vote must not be able to fake a quorum.
+    """
+    vals, seeds = valset4
+    msgs, sigs, vidx, slot = make_batch(vals, seeds, n_txs=1)
+    # one honest vote replayed 3x + one fresh honest vote = 2 real voters
+    m = [msgs[0]] * 3 + [msgs[1]]
+    s = [sigs[0]] * 3 + [sigs[1]]
+    vi = np.array([vidx[0]] * 3 + [vidx[1]])
+    sl = np.array([0, 0, 0, 0])
+    r = assert_parity(vals, m, s, vi, sl, n_slots=1)
+    assert r.stake[0] == 20 and not r.maj23[0]
+    np.testing.assert_array_equal(r.dropped, [False, True, True, False])
+    assert r.valid.tolist() == [True, False, False, True]
+
+
+def test_bucket_size():
+    assert bucket_size(1) == 64
+    assert bucket_size(64) == 64
+    assert bucket_size(65) == 256
+    assert bucket_size(70000, multiple=8) == 70000
+    assert bucket_size(70001, multiple=8) == 70008

@@ -56,9 +56,7 @@ def neg_pubkey_table(pub_key: bytes) -> tuple[np.ndarray, bool]:
     """
     A = host_ed.point_decompress(pub_key)
     if A is None:
-        return np.broadcast_to(
-            curve.build_pniels_table(host_ed.IDENTITY), (16, 4, 32)
-        ).copy(), False
+        return curve.build_pniels_table(host_ed.IDENTITY), False
     return curve.build_pniels_table(host_ed.point_neg(A)), True
 
 

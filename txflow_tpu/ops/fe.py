@@ -20,7 +20,10 @@ Bounds discipline (checked by tests/test_fe.py):
 - "normalized": limbs in [0, 512)   — output of fe_carry/fe_mul/fe_sub.
 - fe_mul/fe_sq inputs must have limbs in [0, 1311]; sums of two normalized
   values (fe_add output, <= 1024) are therefore legal mul inputs.
-- fe_sub(a, b) requires b limbs <= 2040 (it adds the limbwise constant 8*p).
+- fe_sub(a, b) adds the limbwise constant 8*p before subtracting, so the
+  borrow-free requirement is per-limb: b[0] <= 8*0xED = 1896,
+  b[1..30] <= 8*0xFF = 2040, b[31] <= 8*0x7F = 1016. All call sites pass
+  normalized-or-added values (limbs <= 1024), well inside every bound.
 """
 
 from __future__ import annotations
@@ -36,8 +39,9 @@ MASK = (1 << RADIX) - 1
 # p = 2^255 - 19, little-endian radix-256 limbs.
 P_INT = 2**255 - 19
 P_LIMBS = np.array([0xED] + [0xFF] * 30 + [0x7F], dtype=np.int32)
-# Limbwise 8*p: a value ≡ 0 (mod p) that dominates any subtrahend with
-# limbs <= 2040, making limbwise subtraction borrow-free.
+# Limbwise 8*p: a value ≡ 0 (mod p) that dominates any subtrahend within
+# the per-limb bounds documented above, making limbwise subtraction
+# borrow-free.
 EIGHT_P_LIMBS = 8 * P_LIMBS
 
 # Anti-diagonal gather plan for the 32x32 limb product: column k of the

@@ -1,0 +1,65 @@
+"""Mesh construction + shard_map'd verify/tally.
+
+Sharding layout (tpu-first, not a translation of the reference's per-peer
+goroutines):
+
+- vote-batch axis ("votes"): fully sharded — every per-vote array
+  (scalar nibbles, pubkey window tables, R encodings, masks, slots, powers)
+  is split across devices; the curve kernel runs embarrassingly parallel.
+- tx-slot stake vector: computed as per-shard partial segment-sums, then
+  ``psum`` over the mesh axis — one ICI collective per step — so every
+  shard holds the identical global tally and quorum mask (replicated out).
+
+This function is what ``__graft_entry__.dryrun_multichip`` compiles over an
+N-virtual-device mesh, and what the engine uses on a real multi-chip slice.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..ops import ed25519_batch, tally
+
+VOTE_AXIS = "votes"
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = VOTE_AXIS) -> Mesh:
+    """1-D mesh over the first n_devices (default: all) local devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def sharded_verify_and_tally(mesh: Mesh, axis_name: str = VOTE_AXIS):
+    """jit(shard_map) of verify+tally: votes sharded, tally psum-replicated.
+
+    Returns f(verify_inputs_tuple, tx_slot, power, prior_stake, quorum) ->
+    (valid[B] sharded, stake[n_slots] replicated, maj23[n_slots] replicated)
+    with n_slots taken from prior_stake's shape (jit re-specializes per
+    shape). B must be divisible by mesh.size (the verifier pads to buckets
+    that are).
+    """
+    inner = tally.verify_and_tally(ed25519_batch.verify_kernel, axis_name=axis_name)
+
+    vote_specs = (P(axis_name), P(axis_name), P(axis_name), P(axis_name), P(axis_name), P(axis_name))
+    f = shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(vote_specs, P(axis_name), P(axis_name), P(), P()),
+        out_specs=(P(axis_name), P(), P()),
+        # the scan carry in double_scalar_mul starts replicated and becomes
+        # vote-varying, which the static VMA checker rejects; correctness of
+        # the replicated outputs is guaranteed by the psum.
+        check_vma=False,
+    )
+    return jax.jit(f)
