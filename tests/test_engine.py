@@ -1,0 +1,215 @@
+"""TxFlow engine end-to-end + golden parity (reference txflow/service_test.go
+and the SURVEY §4 contract: batched device decisions == scalar reference path).
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from txflow_tpu.abci import AppConns, KVStoreApplication
+from txflow_tpu.engine import TxExecutor, TxFlow
+from txflow_tpu.pool import Mempool, TxVotePool
+from txflow_tpu.store import MemDB, TxStore
+from txflow_tpu.types import MockPV, TxVote, Validator, ValidatorSet
+from txflow_tpu.utils.config import EngineConfig, MempoolConfig
+from txflow_tpu.utils.events import EventBus, EventTx
+from txflow_tpu.verifier import ScalarVoteVerifier
+
+CHAIN_ID = "txflow-test"
+HEIGHT = 1
+
+
+def make_pvs(n=4):
+    pvs = sorted((MockPV() for _ in range(n)), key=lambda p: p.get_address())
+    vals = ValidatorSet([Validator.from_pub_key(pv.get_pub_key(), 10) for pv in pvs])
+    by_addr = {pv.get_address(): pv for pv in pvs}
+    return [by_addr[v.address] for v in vals], vals
+
+
+def make_engine(vals, app=None, use_device=True, max_batch=1024):
+    conns = AppConns(app or KVStoreApplication())
+    mempool = Mempool(MempoolConfig(cache_size=1000), conns.mempool)
+    commitpool = Mempool(MempoolConfig(cache_size=1000))
+    votepool = TxVotePool(MempoolConfig(cache_size=10000))
+    tx_store = TxStore(MemDB())
+    bus = EventBus()
+    execu = TxExecutor(conns.consensus, mempool, event_bus=bus)
+    flow = TxFlow(
+        CHAIN_ID,
+        HEIGHT,
+        vals,
+        votepool,
+        mempool,
+        commitpool,
+        execu,
+        tx_store,
+        config=EngineConfig(max_batch=max_batch, use_device=use_device),
+    )
+    return flow, mempool, commitpool, votepool, tx_store, conns.app, bus
+
+
+def sign_vote(pv, tx: bytes, height=HEIGHT, ts=1700000000_000000000) -> TxVote:
+    v = TxVote(
+        height=height,
+        tx_hash=hashlib.sha256(tx).hexdigest().upper(),
+        tx_key=hashlib.sha256(tx).digest(),
+        timestamp_ns=ts,
+        validator_address=pv.get_address(),
+    )
+    pv.sign_tx_vote(CHAIN_ID, v)
+    return v
+
+
+def test_end_to_end_commit_on_quorum():
+    pvs, vals = make_pvs(4)
+    flow, mempool, commitpool, votepool, tx_store, app, bus = make_engine(vals)
+    sub = bus.subscribe(EventTx)
+
+    txs = [b"k%d=v%d" % (i, i) for i in range(5)]
+    for tx in txs:
+        mempool.check_tx(tx)
+    for tx in txs:
+        for pv in pvs[:3]:  # exactly quorum: 30 >= 27
+            votepool.check_tx(sign_vote(pv, tx))
+
+    processed = flow.step()
+    assert processed == 15
+
+    # every tx committed: app saw it, commitpool holds it, store certifies it
+    assert app.tx_count == 5
+    assert app.state[b"k0"] == b"v0"
+    assert commitpool.size() == 5
+    assert mempool.size() == 0  # removed by executor commit/update
+    for tx in txs:
+        tx_hash = hashlib.sha256(tx).hexdigest().upper()
+        commit = tx_store.load_tx_commit(tx_hash)
+        assert commit is not None and len(commit.commits) == 3
+    # quorum votes purged from the pool, in-flight sets dropped
+    assert votepool.size() == 0
+    assert flow.vote_sets == {}
+    # commit events fired per tx
+    events = sub.drain()
+    assert len(events) == 5 and events[0].data.tx == txs[0]
+
+
+def test_no_commit_below_quorum():
+    pvs, vals = make_pvs(4)
+    flow, mempool, commitpool, votepool, tx_store, app, _ = make_engine(vals)
+    tx = b"under=quorum"
+    mempool.check_tx(tx)
+    for pv in pvs[:2]:  # 20 < 27
+        votepool.check_tx(sign_vote(pv, tx))
+    flow.step()
+    assert app.tx_count == 0
+    assert commitpool.size() == 0
+    assert votepool.size() == 2  # votes stay pending
+    tx_hash = hashlib.sha256(tx).hexdigest().upper()
+    assert flow.vote_sets[tx_hash].stake() == 20
+    # third vote arrives in a later batch: quorum crosses using prior stake
+    votepool.check_tx(sign_vote(pvs[2], tx))
+    flow.step()
+    assert app.tx_count == 1
+    assert votepool.size() == 0
+
+
+def test_byzantine_and_invalid_votes_rejected():
+    pvs, vals = make_pvs(4)
+    flow, mempool, _, votepool, _, app, _ = make_engine(vals)
+    tx = b"target=1"
+    mempool.check_tx(tx)
+
+    good = sign_vote(pvs[0], tx)
+    votepool.check_tx(good)
+    # corrupt signature
+    bad = sign_vote(pvs[1], tx)
+    bad.signature = bad.signature[:-1] + bytes([bad.signature[-1] ^ 1])
+    votepool.check_tx(bad)
+    # non-validator vote
+    stranger = MockPV()
+    votepool.check_tx(sign_vote(stranger, tx))
+    # conflicting second signature from validator 0 (different timestamp)
+    conflict = sign_vote(pvs[0], tx, ts=1700000001_000000000)
+    votepool.check_tx(conflict)
+
+    flow.step()
+    flow.step()  # second pass clears the conflicting leftover
+    assert app.tx_count == 0
+    tx_hash = hashlib.sha256(tx).hexdigest().upper()
+    assert flow.vote_sets[tx_hash].stake() == 10  # only the good vote counted
+    # bad votes were removed from the pool; the good one stays available
+    # for gossip until its tx commits (reference purges only on commit)
+    assert votepool.size() == 1
+    assert votepool.has(__import__("txflow_tpu.pool.txvotepool", fromlist=["vote_key"]).vote_key(good))
+
+
+def test_late_votes_for_committed_tx_are_dropped():
+    pvs, vals = make_pvs(4)
+    flow, mempool, _, votepool, tx_store, app, _ = make_engine(vals)
+    tx = b"late=vote"
+    mempool.check_tx(tx)
+    for pv in pvs[:3]:
+        votepool.check_tx(sign_vote(pv, tx))
+    flow.step()
+    assert app.tx_count == 1
+    # the 4th vote arrives after commit
+    votepool.check_tx(sign_vote(pvs[3], tx))
+    flow.step()
+    assert votepool.size() == 0
+    assert app.tx_count == 1  # not re-committed
+    assert flow.vote_sets == {}
+
+
+def test_batched_matches_scalar_reference_engine():
+    """Golden parity: identical commit decisions, app state and stores for a
+    shuffled, adversarial vote stream (BASELINE config 4 in miniature)."""
+    import random
+
+    rng = random.Random(42)
+    pvs, vals = make_pvs(7)  # total 70, quorum 47 -> 5 votes needed
+    txs = [b"ptx%d=%d" % (i, i) for i in range(12)]
+
+    stream = []
+    for t_i, tx in enumerate(txs):
+        n_votes = rng.randint(2, 7)
+        voters = rng.sample(range(7), n_votes)
+        for vi in voters:
+            vote = sign_vote(pvs[vi], tx)
+            if rng.random() < 0.15:  # corrupt some
+                vote.signature = bytes(64)
+            stream.append(vote)
+    rng.shuffle(stream)
+
+    # scalar reference engine: one vote at a time through add_vote
+    flow_s, mem_s, commit_s, pool_s, store_s, app_s, _ = make_engine(vals, use_device=False)
+    for tx in txs:
+        mem_s.check_tx(tx)
+    for v in stream:
+        flow_s.try_add_vote(v.copy())
+
+    # batched device engine: same stream via the pool, uneven batch sizes
+    flow_b, mem_b, commit_b, pool_b, store_b, app_b, _ = make_engine(vals, max_batch=17)
+    for tx in txs:
+        mem_b.check_tx(tx)
+    for v in stream:
+        try:
+            pool_b.check_tx(v)
+        except Exception:
+            pass
+    while flow_b.step():
+        pass
+
+    assert app_b.tx_count == app_s.tx_count
+    assert app_b.state == app_s.state
+    assert app_b.digest == app_s.digest  # commit ORDER identical, not just set
+    for tx in txs:
+        tx_hash = hashlib.sha256(tx).hexdigest().upper()
+        cs, cb = store_s.load_tx_commit(tx_hash), store_b.load_tx_commit(tx_hash)
+        assert (cs is None) == (cb is None)
+        if cs is not None:
+            assert {c.validator_address for c in cs.commits} == {
+                c.validator_address for c in cb.commits
+            }
+    # uncommitted stake identical
+    for tx_hash, vs in flow_s.vote_sets.items():
+        assert flow_b.vote_sets[tx_hash].stake() == vs.stake()

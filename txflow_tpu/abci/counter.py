@@ -1,0 +1,55 @@
+"""counter example app (reference test app: abci/example/counter).
+
+With ``serial=True`` txs must be exactly the big-endian encoding of the
+next integer — the reference pool tests use this to assert ordered reaping
+(txvotepool/txvotepool_test.go:166).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from .application import Application
+from .types import ResponseCheckTx, ResponseCommit, ResponseDeliverTx
+
+
+def _decode(tx: bytes) -> int:
+    if len(tx) > 8:
+        return -1
+    return int.from_bytes(tx, "big")
+
+
+class CounterApplication(Application):
+    def __init__(self, serial: bool = False):
+        self.serial = serial
+        self.tx_count = 0
+        self.check_count = 0
+
+    def check_tx(self, tx: bytes) -> ResponseCheckTx:
+        if self.serial:
+            value = _decode(tx)
+            if len(tx) > 8:
+                return ResponseCheckTx(code=1, log=f"tx too large: {len(tx)} bytes")
+            if value < self.tx_count:
+                return ResponseCheckTx(
+                    code=2,
+                    log=f"invalid nonce: got {value}, expected >= {self.tx_count}",
+                )
+        self.check_count += 1
+        return ResponseCheckTx()
+
+    def deliver_tx(self, tx: bytes) -> ResponseDeliverTx:
+        if self.serial:
+            value = _decode(tx)
+            if value != self.tx_count:
+                return ResponseDeliverTx(
+                    code=2,
+                    log=f"invalid nonce: got {value}, expected {self.tx_count}",
+                )
+        self.tx_count += 1
+        return ResponseDeliverTx()
+
+    def commit(self) -> ResponseCommit:
+        if self.tx_count == 0:
+            return ResponseCommit()
+        return ResponseCommit(data=struct.pack(">Q", self.tx_count))

@@ -1,0 +1,15 @@
+"""Fast-path commit engine (reference txflow/ + txflowstate/).
+
+``TxFlow`` aggregates gossiped TxVotes into per-tx quorums and commits each
+tx the moment >2/3 of stake has signed it; ``TxExecutor`` executes one
+committed tx against the ABCI app. The reference does this one vote at a
+time in a goroutine (txflow/service.go:123-166); here votes are drained in
+batches through the device verifier (ed25519 verify + stake tally in one
+XLA program), with the host TxVoteSets remaining the authoritative,
+bit-identical record of every commit decision.
+"""
+
+from .execution import TxExecutor
+from .txflow import TxFlow
+
+__all__ = ["TxExecutor", "TxFlow"]

@@ -1,0 +1,92 @@
+"""TxExecutor: single-tx execution engine (reference txflowstate/execution.go).
+
+ApplyTx pipeline, order preserved from the reference (:77-104):
+DeliverTx on the consensus connection -> app Commit (with the mempool
+locked and flushed, :112-155) -> mempool.update removes the tx -> per-tx
+commit event fired last (:190-195). Fail-points before/after Commit mirror
+the reference's ``fail.Fail()`` crash hooks for crash-consistency tests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+
+from ..abci.proxy import AppConnConsensus
+from ..pool.mempool import Mempool
+from ..utils import failpoints
+from ..utils.events import EventBus, EventDataTx, EventTx
+from ..utils.metrics import TxFlowMetrics
+
+
+class TxExecutor:
+    def __init__(
+        self,
+        proxy_app: AppConnConsensus,
+        mempool: Mempool,
+        event_bus: EventBus | None = None,
+        metrics: TxFlowMetrics | None = None,
+    ):
+        self.proxy_app = proxy_app
+        self.mempool = mempool
+        self.event_bus = event_bus
+        self.metrics = metrics or TxFlowMetrics()
+
+    def set_event_bus(self, bus: EventBus) -> None:
+        self.event_bus = bus
+
+    def apply_tx(self, height: int, tx: bytes):
+        """Execute + commit one fast-path tx; returns (app_hash, deliver_res)."""
+        t0 = time.perf_counter()
+        deliver_res = self._exec_tx_on_proxy_app(tx)
+        self.metrics.tx_processing_time.observe(time.perf_counter() - t0)
+
+        failpoints.fail("txflow-before-commit")
+
+        app_hash = self._commit(height, tx, deliver_res)
+
+        failpoints.fail("txflow-after-commit")
+
+        self._fire_events(height, tx, deliver_res)
+        return app_hash, deliver_res
+
+    def _exec_tx_on_proxy_app(self, tx: bytes):
+        """DeliverTx (async submit + flush fence; reference :161-185)."""
+        res = self.proxy_app.deliver_tx_async(tx)
+        self.proxy_app.flush()
+        return res.value
+
+    def _commit(self, height: int, tx: bytes, deliver_res) -> bytes:
+        """App Commit under the mempool lock (reference Commit :112-155)."""
+        self.mempool.lock()
+        try:
+            self.proxy_app.flush()
+            commit_res = self.proxy_app.commit_sync()
+            self.mempool.update(height, [tx], [deliver_res])
+            return commit_res.data
+        finally:
+            self.mempool.unlock()
+
+    def exec_commit_tx(self, tx: bytes) -> bytes:
+        """Execute without state/mempool side effects (replay path,
+        reference ExecCommitTx :202-220)."""
+        res = self.proxy_app.deliver_tx_async(tx)
+        self.proxy_app.flush()
+        commit_res = self.proxy_app.commit_sync()
+        del res
+        return commit_res.data
+
+    def _fire_events(self, height: int, tx: bytes, deliver_res) -> None:
+        if self.event_bus is None:
+            return
+        self.event_bus.publish(
+            EventTx,
+            EventDataTx(
+                height=height,
+                tx=tx,
+                tx_hash=hashlib.sha256(tx).hexdigest().upper(),
+                result_code=deliver_res.code,
+                result_data=deliver_res.data,
+                result_log=deliver_res.log,
+            ),
+        )

@@ -1,0 +1,274 @@
+"""TxFlow: per-tx vote aggregation + instant commit (reference txflow/service.go).
+
+The reference's ``checkMaj23Routine`` walks the vote-pool CList one vote at
+a time, verifying each ed25519 signature on the host under a mutex
+(:123-166 -> types/vote_set.go:81-131). Here one aggregation **step**:
+
+1. drains a batch of pending votes from the pool (insertion order — the
+   canonical intra-batch order, so replays and the scalar model agree);
+2. assigns a tx slot per distinct tx hash and gathers each slot's prior
+   accumulated stake from its host TxVoteSet;
+3. runs the batched device verify+tally (one XLA program: ed25519 double
+   scalar mult + segment-sum stake + quorum compare);
+4. routes each verified vote into its authoritative ``TxVoteSet`` via the
+   reference-identical decision path (first-signature-wins, conflict
+   rejection) and, for every tx that crossed 2/3:
+   save to TxStore -> fetch tx from mempool by key -> ApplyTx -> purge the
+   quorum's votes from the pool -> push tx into the commitpool (exactly the
+   sequence of txflow/service.go:216-232).
+
+Divergences from the reference (defects fixed, per SURVEY.md §0):
+- committed TxVoteSets are dropped from the in-flight map (the reference
+  leaks them, service.go:200-209); late votes for a committed tx are
+  discarded via the committed-cache/TxStore check;
+- votes that can never be added (invalid signature, conflicting signature,
+  unknown validator) are removed from the pool instead of lingering
+  forever in the CList.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..pool.mempool import Mempool
+from ..pool.txvotepool import TxVotePool
+from ..store.tx_store import TxStore
+from ..types import TxVote, TxVoteSet
+from ..types.validator import ValidatorSet
+from ..utils.cache import LRUCache
+from ..utils.config import EngineConfig
+from ..utils.metrics import TxFlowMetrics
+from ..verifier import DeviceVoteVerifier, ScalarVoteVerifier
+from .execution import TxExecutor
+
+
+class TxFlow:
+    def __init__(
+        self,
+        chain_id: str,
+        height: int,
+        val_set: ValidatorSet,
+        tx_vote_pool: TxVotePool,
+        mempool: Mempool,
+        commitpool: Mempool,
+        tx_executor: TxExecutor,
+        tx_store: TxStore,
+        config: EngineConfig | None = None,
+        verifier=None,
+        metrics: TxFlowMetrics | None = None,
+    ):
+        self.chain_id = chain_id
+        self.height = height
+        self.val_set = val_set
+        self.tx_vote_pool = tx_vote_pool
+        self.mempool = mempool
+        self.commitpool = commitpool
+        self.tx_executor = tx_executor
+        self.tx_store = tx_store
+        self.config = config or EngineConfig()
+        self.metrics = metrics or TxFlowMetrics()
+        if verifier is not None:
+            self.verifier = verifier
+        elif self.config.use_device:
+            self.verifier = DeviceVoteVerifier(val_set)
+        else:
+            self.verifier = ScalarVoteVerifier(val_set)
+        self._addr_to_idx = {v.address: i for i, v in enumerate(val_set)}
+        self.vote_sets: dict[str, TxVoteSet] = {}  # in-flight only
+        self._committed = LRUCache(1 << 16)  # recently committed tx hashes
+        self._added_keys: set[bytes] = set()  # pool keys already in a vote set
+        self._mtx = threading.RLock()
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self.app_hash = b""
+
+    # ---- lifecycle (reference OnStart :80-87) ----
+
+    def start(self) -> None:
+        with self._mtx:
+            if self._running:
+                return
+            self._running = True
+        self.tx_vote_pool.enable_txs_available()
+        self._thread = threading.Thread(target=self._run, name="txflow", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._mtx:
+            self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        ev = self.tx_vote_pool.txs_available()
+        while True:
+            with self._mtx:
+                if not self._running:
+                    return
+            processed = self.step()
+            if processed == 0:
+                ev.wait(timeout=self.config.poll_interval)
+
+    # ---- batched aggregation step ----
+
+    def step(self) -> int:
+        """One verify+tally+commit round; returns votes processed."""
+        t0 = time.perf_counter()
+        with self._mtx:
+            batch = self.tx_vote_pool.drain_batch(
+                self.config.max_batch, skip=self._added_keys
+            )
+            if not batch:
+                return 0
+            keys, votes, slots, slot_of, drop_now = [], [], [], {}, []
+            for key, vote in batch:
+                if self._committed.__contains__(_hash_key(vote.tx_hash)) or (
+                    vote.tx_hash not in self.vote_sets
+                    and self.tx_store.has_tx(vote.tx_hash)
+                ):
+                    drop_now.append(key)  # late vote for a committed tx
+                    continue
+                vs = self.vote_sets.get(vote.tx_hash)
+                if vs is not None and vs.get_by_address(vote.validator_address) is not None:
+                    # the set already holds a vote from this validator:
+                    # identical signature = silent dup, different = conflict
+                    # (rejected) — either way it can never be added
+                    drop_now.append(key)
+                    continue
+                slot = slot_of.setdefault(vote.tx_hash, len(slot_of))
+                if slot >= self.config.max_slots:
+                    break  # leave the tail for the next step
+                keys.append(key)
+                votes.append(vote)
+                slots.append(slot)
+            if drop_now:
+                self.tx_vote_pool.remove(drop_now)
+            if not votes:
+                return len(drop_now)
+
+            n_slots = len(slot_of)
+            prior = np.zeros(n_slots, np.int64)
+            hashes = [None] * n_slots
+            for tx_hash, s in slot_of.items():
+                hashes[s] = tx_hash
+                vs = self.vote_sets.get(tx_hash)
+                if vs is not None:
+                    prior[s] = vs.stake()
+
+            msgs = [v.sign_bytes(self.chain_id) for v in votes]
+            sigs = [v.signature or b"" for v in votes]
+            val_idx = np.array(
+                [self._addr_to_idx.get(v.validator_address, -1) for v in votes],
+                dtype=np.int64,
+            )
+            result = self.verifier.verify_and_tally(
+                msgs, sigs, val_idx, np.array(slots, np.int32), n_slots,
+                prior_stake=prior,
+            )
+            self.metrics.batch_size.observe(len(votes))
+            self.metrics.verified_votes.add(int(result.valid.sum()))
+
+            # route decisions in batch order (canonical) into the vote sets,
+            # committing INLINE the moment a set crosses 2/3 — exactly the
+            # reference's per-vote order (service.go:192-234), so commit
+            # certificates are identical to the serial path, not padded
+            # with same-batch late votes
+            bad_keys: list[bytes] = []
+            for i, vote in enumerate(votes):
+                if result.dropped[i]:
+                    continue  # in-batch repeat: re-examined next step
+                if not result.valid[i]:
+                    self.metrics.invalid_votes.add(1)
+                    bad_keys.append(keys[i])
+                    continue
+                vs = self.vote_sets.get(vote.tx_hash)
+                if vs is None:
+                    if self._committed.__contains__(_hash_key(vote.tx_hash)):
+                        bad_keys.append(keys[i])  # late: committed this batch
+                        continue
+                    vs = TxVoteSet(
+                        self.chain_id, self.height, vote.tx_hash, vote.tx_key, self.val_set
+                    )
+                    self.vote_sets[vote.tx_hash] = vs
+                added, err = vs.add_verified_vote(vote)
+                if added:
+                    self._added_keys.add(keys[i])
+                    if vs.has_two_thirds_majority():
+                        self._commit_tx(vs)
+                else:
+                    bad_keys.append(keys[i])  # dup/conflict: can never add
+            if bad_keys:
+                self.tx_vote_pool.remove(bad_keys)
+
+        self.metrics.step_time.observe(time.perf_counter() - t0)
+        return len(votes) + len(drop_now)
+
+    # ---- scalar parity API (reference TryAddVote :169-188) ----
+
+    def try_add_vote(self, vote: TxVote) -> tuple[bool, Exception | None]:
+        with self._mtx:
+            return self._add_vote_scalar(vote)
+
+    def _add_vote_scalar(self, vote: TxVote) -> tuple[bool, Exception | None]:
+        """Reference-exact scalar path (used by tests as the golden engine)."""
+        if self._committed.__contains__(_hash_key(vote.tx_hash)) or (
+            vote.tx_hash not in self.vote_sets and self.tx_store.has_tx(vote.tx_hash)
+        ):
+            return False, None
+        vs = self.vote_sets.get(vote.tx_hash)
+        if vs is None:
+            vs = TxVoteSet(self.chain_id, self.height, vote.tx_hash, vote.tx_key, self.val_set)
+            self.vote_sets[vote.tx_hash] = vs
+        added, err = vs.add_vote(vote)
+        if added and vs.has_two_thirds_majority():
+            self._commit_tx(vs)
+        return added, err
+
+    # ---- commit (reference addVote :216-232) ----
+
+    def _commit_tx(self, vs: TxVoteSet) -> None:
+        self.tx_store.save_tx(vs)
+        tx = self.mempool.get_tx(vs.tx_key)
+        if tx is not None:
+            app_hash, _ = self.tx_executor.apply_tx(self.height, tx)
+            self.app_hash = app_hash
+            self.metrics.committed_txs.add(1)
+            try:
+                self.commitpool.check_tx(tx)
+            except Exception:
+                pass  # commitpool dup (e.g. replays) is harmless
+        quorum_votes = vs.get_votes()
+        self.metrics.committed_votes.add(len(quorum_votes))
+        from ..pool.txvotepool import vote_key as _vk
+
+        for v in quorum_votes:
+            self._added_keys.discard(_vk(v))
+        self.tx_vote_pool.update(self.height, quorum_votes)
+        # fixed leak: drop the in-flight set, remember the hash
+        self.vote_sets.pop(vs.tx_hash, None)
+        self._committed.push(_hash_key(vs.tx_hash))
+
+    # ---- queries (reference LoadCommit :116-120) ----
+
+    def load_commit(self, tx_hash: str):
+        return self.tx_store.load_tx_commit(tx_hash)
+
+    def update_state(self, height: int, val_set: ValidatorSet) -> None:
+        """Block boundary: new height / possibly rotated validator set."""
+        with self._mtx:
+            self.height = height
+            if val_set is not self.val_set:
+                self.val_set = val_set
+                self._addr_to_idx = {v.address: i for i, v in enumerate(val_set)}
+                if isinstance(self.verifier, DeviceVoteVerifier):
+                    self.verifier = DeviceVoteVerifier(val_set, mesh=self.verifier.mesh)
+                else:
+                    self.verifier = ScalarVoteVerifier(val_set)
+
+
+def _hash_key(tx_hash: str) -> bytes:
+    return tx_hash.encode()

@@ -78,6 +78,13 @@ class EpochTables:
         self.pub_keys = list(pub_keys)
         self.tables = np.stack(tables) if tables else np.zeros((0, 16, 4, 32), np.int32)
         self.key_ok = np.array(oks, dtype=bool)
+        self._device_tables = None
+
+    def device_tables(self):
+        """Epoch tables as a device array, uploaded once and cached."""
+        if self._device_tables is None:
+            self._device_tables = jnp.asarray(self.tables)
+        return self._device_tables
 
 
 def prepare_batch(
@@ -139,6 +146,106 @@ def verify_kernel(s_nibbles, h_nibbles, a_tables, r_y, r_sign, pre_ok):
 
 
 verify_kernel_jit = jax.jit(verify_kernel)
+
+
+# ----------------------------------------------------------------------------
+# Compact path: minimal H2D traffic, device-side epoch-table gather.
+#
+# The naive path above ships a gathered [B, 16, 4, 32] int32 table block per
+# batch (~8 KiB/vote — measured to cap sustained throughput at ~80k votes/s
+# on PCIe-class links). Here the per-epoch tables live on device once and
+# votes ship as ~162 bytes each (u8 nibbles + R bytes + indices); the
+# validator gather happens device-side inside the jit.
+
+
+@dataclass
+class CompactBatch:
+    """Host-prepared compact device inputs for a batch of B checks."""
+
+    s_nibbles: np.ndarray  # [B, 64] uint8, MSB-first nibbles of S
+    h_nibbles: np.ndarray  # [B, 64] uint8, MSB-first nibbles of h mod L
+    val_idx: np.ndarray  # [B] int32 validator index (clipped on device)
+    r_y: np.ndarray  # [B, 32] uint8 low 255 bits of sig[:32]
+    r_sign: np.ndarray  # [B] uint8 bit 255 of sig[:32]
+    pre_ok: np.ndarray  # [B] bool host pre-checks passed
+
+    @property
+    def size(self) -> int:
+        return self.s_nibbles.shape[0]
+
+
+def nibbles_from_le_bytes(b: np.ndarray) -> np.ndarray:
+    """[B, 32] little-endian uint8 scalars -> [B, 64] MSB-first nibbles."""
+    rev = b[:, ::-1]
+    out = np.empty((b.shape[0], 64), np.uint8)
+    out[:, 0::2] = rev >> 4
+    out[:, 1::2] = rev & 15
+    return out
+
+
+def prepare_compact(
+    msgs: list[bytes],
+    sigs: list[bytes],
+    val_idx: np.ndarray,
+    epoch: EpochTables,
+) -> CompactBatch:
+    """Vectorized host prep: only SHA-512 folding stays a Python loop."""
+    n = len(msgs)
+    n_vals = len(epoch.pub_keys)
+    vi = np.asarray(val_idx, dtype=np.int64)
+    idx_ok = (vi >= 0) & (vi < n_vals)
+    sig_arr = np.zeros((n, 64), np.uint8)
+    s_le = np.zeros((n, 32), np.uint8)
+    h_le = np.zeros((n, 32), np.uint8)
+    pre_ok = np.zeros(n, bool)
+    for i in range(n):
+        sig = sigs[i]
+        if len(sig) != 64 or not idx_ok[i] or not epoch.key_ok[vi[i]]:
+            continue
+        s = int.from_bytes(sig[32:], "little")
+        if s >= host_ed.L:  # ScMinimal
+            continue
+        pub = epoch.pub_keys[vi[i]]
+        h = (
+            int.from_bytes(hashlib.sha512(sig[:32] + pub + msgs[i]).digest(), "little")
+            % host_ed.L
+        )
+        sig_arr[i] = np.frombuffer(sig, np.uint8)
+        s_le[i] = np.frombuffer(sig[32:], np.uint8)
+        h_le[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
+        pre_ok[i] = True
+    r_y = sig_arr[:, :32].copy()
+    r_sign = (r_y[:, 31] >> 7).astype(np.uint8)
+    r_y[:, 31] &= 0x7F
+    return CompactBatch(
+        nibbles_from_le_bytes(s_le),
+        nibbles_from_le_bytes(h_le),
+        np.clip(vi, 0, max(n_vals - 1, 0)).astype(np.int32),
+        r_y,
+        r_sign,
+        pre_ok,
+    )
+
+
+def verify_kernel_gather(s_nibbles, h_nibbles, val_idx, tables, r_y, r_sign, pre_ok):
+    """Device kernel with on-device epoch-table gather.
+
+    tables: [V, 16, 4, 32] int32, device-resident per epoch. Per-vote inputs
+    are compact uint8; widened to int32 on device. Decisions are identical
+    to ``verify_kernel``.
+    """
+    a_tables = jnp.take(tables, val_idx, axis=0)
+    p = curve.double_scalar_mul(
+        s_nibbles.astype(jnp.int32),
+        h_nibbles.astype(jnp.int32),
+        jnp.asarray(curve.BASE_TABLE),
+        a_tables,
+    )
+    y, x_parity = curve.ext_encode(p)
+    enc_match = fe.fe_is_equal_frozen(y, r_y.astype(jnp.int32)) & (
+        x_parity == r_sign.astype(jnp.int32)
+    )
+    return enc_match & pre_ok
 
 
 def verify_batch(batch: PreparedBatch) -> np.ndarray:

@@ -57,3 +57,30 @@ def verify_and_tally(verify_fn, axis_name: str | None = None):
         return valid, total, total >= quorum
 
     return f
+
+
+def compact_step(axis_name: str | None = None):
+    """The fused aggregation step over a compact batch (the hot path).
+
+    f(s_nib, h_nib, val_idx, r_y, r_sign, pre_ok, tx_slot, tables, powers,
+      prior_stake, quorum) -> (valid[B], stake[n_slots], maj23[n_slots]).
+
+    Per-epoch constants (``tables`` [V,16,4,32], ``powers`` int32[V]) stay
+    device-resident across batches; per-vote inputs are compact uint8/int32
+    (~162 B/vote of H2D). Voting power is gathered on device by validator
+    index — a vote contributes iff its signature verified.
+    """
+    from . import ed25519_batch
+
+    def f(s_nib, h_nib, val_idx, r_y, r_sign, pre_ok, tx_slot, tables, powers, prior_stake, quorum):
+        valid = ed25519_batch.verify_kernel_gather(
+            s_nib, h_nib, val_idx, tables, r_y, r_sign, pre_ok
+        )
+        power = jnp.take(powers, val_idx)
+        stake = tally_kernel(valid, tx_slot, power, prior_stake.shape[0])
+        if axis_name is not None:
+            stake = jax.lax.psum(stake, axis_name)
+        total = prior_stake + stake
+        return valid, total, total >= quorum
+
+    return f

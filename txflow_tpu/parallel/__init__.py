@@ -8,6 +8,16 @@ batch and partial stake tallies are combined with a single ``psum`` over
 ICI — the workload's analog of sequence parallelism.
 """
 
-from .mesh import make_mesh, sharded_verify_and_tally, VOTE_AXIS
+from .mesh import (
+    VOTE_AXIS,
+    make_mesh,
+    sharded_compact_step,
+    sharded_verify_and_tally,
+)
 
-__all__ = ["make_mesh", "sharded_verify_and_tally", "VOTE_AXIS"]
+__all__ = [
+    "make_mesh",
+    "sharded_compact_step",
+    "sharded_verify_and_tally",
+    "VOTE_AXIS",
+]

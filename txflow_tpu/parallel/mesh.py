@@ -63,3 +63,23 @@ def sharded_verify_and_tally(mesh: Mesh, axis_name: str = VOTE_AXIS):
         check_vma=False,
     )
     return jax.jit(f)
+
+
+def sharded_compact_step(mesh: Mesh, axis_name: str = VOTE_AXIS):
+    """jit(shard_map) of the compact fused step (ops.tally.compact_step).
+
+    Per-vote arrays shard over the vote axis; the per-epoch table/power
+    constants and the prior-stake/quorum scalars are replicated; per-shard
+    partial stake tallies psum over ICI. Same call signature as the
+    single-device compact step.
+    """
+    inner = tally.compact_step(axis_name=axis_name)
+    v = P(axis_name)
+    f = shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(v, v, v, v, v, v, v, P(), P(), P(), P()),
+        out_specs=(v, P(), P()),
+        check_vma=False,  # same scan-carry VMA caveat as above
+    )
+    return jax.jit(f)

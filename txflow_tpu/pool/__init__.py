@@ -1,0 +1,26 @@
+"""Pending-state pools (reference mempool/ and txvotepool/).
+
+- ``Mempool``: raw transactions awaiting block inclusion, plus keyed
+  ``get_tx`` lookup used by the fast-path commit; a second instance serves
+  as the **commitpool** holding fast-committed txs for block Vtxs
+  (reference node/node.go:627-633).
+- ``TxVotePool``: pending TxVotes with signature-keyed dedup, caps and WAL.
+
+Both keep the reference's observable semantics (ordering, caps, cache,
+availability signaling) without the CList idiom — an insertion-ordered
+dict + condition variables serve the same contract for host-side code,
+while the hot consumption path drains whole batches for the device kernel.
+"""
+
+from .mempool import ErrMempoolIsFull, ErrTxInCache, ErrTxTooLarge, Mempool, TxInfo
+from .txvotepool import TxVotePool, UNKNOWN_PEER_ID
+
+__all__ = [
+    "ErrMempoolIsFull",
+    "ErrTxInCache",
+    "ErrTxTooLarge",
+    "Mempool",
+    "TxInfo",
+    "TxVotePool",
+    "UNKNOWN_PEER_ID",
+]

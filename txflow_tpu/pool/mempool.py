@@ -1,0 +1,249 @@
+"""Mempool: ordered pool of raw txs (reference mempool/clist_mempool.go).
+
+Forked-mempool behaviors preserved:
+- ABCI CheckTx gate on ingest (app connection serialized by the proxy);
+- sha256 LRU dedup cache, size/bytes caps, peer-sender tracking;
+- ``get_tx(tx_key)`` lookup by sha256 — the fork's one addition
+  (clist_mempool.go:171-177), used by TxFlow on quorum;
+- reap by bytes/gas or by count; ``update`` on commit removes txs,
+  with valid-but-uncommitted txs kept and recheck optional;
+- TxsAvailable notification, once per height;
+- optional WAL of accepted txs.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from ..crypto.hash import sha256
+from ..utils.cache import LRUCache, NopCache
+from ..utils.config import MempoolConfig
+from ..utils.wal import WAL
+
+
+class ErrTxInCache(Exception):
+    pass
+
+
+@dataclass
+class ErrMempoolIsFull(Exception):
+    num_txs: int
+    max_txs: int
+    txs_bytes: int
+    max_txs_bytes: int
+
+    def __str__(self):
+        return (
+            f"mempool is full: number of txs {self.num_txs} (max: {self.max_txs}), "
+            f"total txs bytes {self.txs_bytes} (max: {self.max_txs_bytes})"
+        )
+
+
+@dataclass
+class ErrTxTooLarge(Exception):
+    max_size: int
+    tx_size: int
+
+    def __str__(self):
+        return f"Tx too large. Max size is {self.max_size}, but got {self.tx_size}"
+
+
+@dataclass
+class TxInfo:
+    sender_id: int = 0
+
+
+@dataclass
+class _MempoolTx:
+    height: int
+    gas_wanted: int
+    tx: bytes
+    senders: set[int] = field(default_factory=set)
+
+
+class Mempool:
+    def __init__(
+        self,
+        config: MempoolConfig,
+        proxy_app_conn=None,
+        height: int = 0,
+        pre_check=None,
+        post_check=None,
+        wal_path: str = "",
+    ):
+        self.config = config
+        self.proxy_app = proxy_app_conn
+        self.height = height
+        self.pre_check = pre_check
+        self.post_check = post_check
+        self._mtx = threading.RLock()
+        self._txs: dict[bytes, _MempoolTx] = {}  # tx_key -> entry, insertion order
+        self._txs_bytes = 0
+        self.cache = LRUCache(config.cache_size) if config.cache_size > 0 else NopCache()
+        self._txs_available = threading.Event()
+        self._notified_txs_available = False
+        self._notify_available = False
+        self.wal: WAL | None = WAL(wal_path) if wal_path else None
+
+    # -- introspection --
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._txs)
+
+    def txs_bytes(self) -> int:
+        with self._mtx:
+            return self._txs_bytes
+
+    def is_empty(self) -> bool:
+        return self.size() == 0
+
+    def txs_available(self) -> threading.Event:
+        self._notify_available = True
+        return self._txs_available
+
+    def enable_txs_available(self) -> None:
+        self._notify_available = True
+
+    # -- ingest (reference CheckTx/CheckTxWithInfo :220-303) --
+
+    def check_tx(self, tx: bytes, tx_info: TxInfo | None = None) -> None:
+        """Raises on rejection; returns None when the tx entered the pool."""
+        tx_info = tx_info or TxInfo()
+        with self._mtx:
+            if (
+                len(self._txs) >= self.config.size
+                or len(tx) + self._txs_bytes > self.config.max_txs_bytes
+            ):
+                raise ErrMempoolIsFull(
+                    len(self._txs), self.config.size, self._txs_bytes, self.config.max_txs_bytes
+                )
+            key = sha256(tx)
+            if not self.cache.push(key):
+                entry = self._txs.get(key)
+                if entry is not None:
+                    entry.senders.add(tx_info.sender_id)
+                raise ErrTxInCache()
+            if self.pre_check is not None:
+                err = self.pre_check(tx)
+                if err is not None:
+                    self.cache.remove(key)
+                    raise ValueError(f"rejected by pre_check: {err}")
+            if self.proxy_app is not None:
+                res = self.proxy_app.check_tx_sync(tx)
+                if not res.is_ok:
+                    self.cache.remove(key)
+                    raise ValueError(f"rejected by app CheckTx (code {res.code}): {res.log}")
+                gas = res.gas_wanted
+            else:
+                gas = 0
+            if self.post_check is not None:
+                err = self.post_check(tx)
+                if err is not None:
+                    self.cache.remove(key)
+                    raise ValueError(f"rejected by post_check: {err}")
+            if self.wal is not None:
+                self.wal.write(tx)
+            entry = _MempoolTx(self.height, gas, tx, {tx_info.sender_id})
+            self._txs[key] = entry
+            self._txs_bytes += len(tx)
+            self._notify_txs_available()
+
+    def _notify_txs_available(self) -> None:
+        if self._notify_available and not self._notified_txs_available:
+            self._notified_txs_available = True
+            self._txs_available.set()
+
+    # -- lookup (the fork's GetTx, clist_mempool.go:171-177) --
+
+    def get_tx(self, tx_key: bytes) -> bytes | None:
+        with self._mtx:
+            entry = self._txs.get(tx_key)
+            return entry.tx if entry is not None else None
+
+    def has_sender(self, tx_key: bytes, sender_id: int) -> bool:
+        with self._mtx:
+            entry = self._txs.get(tx_key)
+            return entry is not None and sender_id in entry.senders
+
+    # -- reap (reference :306-355) --
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
+        with self._mtx:
+            out, total_bytes, total_gas = [], 0, 0
+            for entry in self._txs.values():
+                if max_bytes > -1 and total_bytes + len(entry.tx) > max_bytes:
+                    break
+                if max_gas > -1 and total_gas + entry.gas_wanted > max_gas:
+                    break
+                total_bytes += len(entry.tx)
+                total_gas += entry.gas_wanted
+                out.append(entry.tx)
+            return out
+
+    def reap_max_txs(self, n: int) -> list[bytes]:
+        with self._mtx:
+            if n < 0:
+                n = len(self._txs)
+            return [e.tx for _, e in list(self._txs.items())[:n]]
+
+    def entries(self, after: int = 0, limit: int = -1) -> list[tuple[bytes, bytes]]:
+        """Snapshot of (tx_key, tx) pairs in insertion order (gossip walk)."""
+        with self._mtx:
+            items = [(k, e.tx) for k, e in self._txs.items()]
+        if limit >= 0:
+            return items[after : after + limit]
+        return items[after:]
+
+    # -- update on commit (reference :358-422) --
+
+    def lock(self) -> None:
+        self._mtx.acquire()
+
+    def unlock(self) -> None:
+        self._mtx.release()
+
+    def update(
+        self,
+        height: int,
+        txs: list[bytes],
+        deliver_results: list | None = None,
+        pre_check=None,
+        post_check=None,
+    ) -> None:
+        """Remove committed txs. Caller holds the lock (like the reference)."""
+        if pre_check is not None:
+            self.pre_check = pre_check
+        if post_check is not None:
+            self.post_check = post_check
+        self.height = height
+        self._notified_txs_available = False
+        self._txs_available.clear()
+        for i, tx in enumerate(txs):
+            key = sha256(tx)
+            ok = deliver_results is None or (
+                i < len(deliver_results) and deliver_results[i].is_ok
+            )
+            if ok:
+                # valid committed txs stay cached so they cannot re-enter
+                self.cache.push(key)
+            else:
+                # invalid txs may become valid later: allow resubmission
+                self.cache.remove(key)
+            entry = self._txs.pop(key, None)
+            if entry is not None:
+                self._txs_bytes -= len(entry.tx)
+        if len(self._txs) > 0:
+            self._notify_txs_available()
+
+    def flush(self) -> None:
+        with self._mtx:
+            self._txs.clear()
+            self._txs_bytes = 0
+            self.cache.reset()
+
+    def close_wal(self) -> None:
+        if self.wal is not None:
+            self.wal.close()
+            self.wal = None

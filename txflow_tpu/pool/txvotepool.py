@@ -1,0 +1,237 @@
+"""TxVotePool: pending TxVotes (reference txvotepool/txvotepool.go).
+
+Semantics preserved from the reference:
+- dedup key is **sha256(signature)** (:467-469) — two votes for the same tx
+  by the same validator but different sign-bytes are distinct pool entries;
+- size / total-bytes caps checked before cache (:198-208);
+- max single-vote size derived from the gossip msg cap (:211);
+- cache hit records the new sender for in-pool votes then rejects (:213-228);
+- WAL append of accepted votes (:232-243);
+- ``update(height, votes)`` pushes committed votes into the cache, removes
+  them from the pool and re-arms the availability notification (:329-359);
+- per-height TxsAvailable firing, once (:273-307).
+
+The batched consumer adds ``drain_batch`` — a snapshot of up to N votes in
+insertion order *without* removing them (removal happens on commit/purge,
+exactly like the reference's checkMaj23Routine walking the CList without
+popping).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from ..crypto.hash import sha256
+from ..types import TxVote, decode_tx_vote, encode_tx_vote
+from ..utils.cache import LRUCache, NopCache
+from ..utils.config import MempoolConfig
+from ..utils.wal import WAL
+from .mempool import ErrMempoolIsFull, ErrTxInCache, ErrTxTooLarge, TxInfo
+
+UNKNOWN_PEER_ID = 0
+
+# amino overhead allowance for a wrapped vote message (reference
+# calcMaxTxSize subtracts the TxMessage envelope from MaxMsgBytes).
+_MSG_OVERHEAD = 8
+
+
+def vote_key(vote: TxVote) -> bytes:
+    """sha256(signature) — the reference's txVoteKey (:467-469)."""
+    return sha256(vote.signature or b"")
+
+
+@dataclass
+class _PoolVote:
+    height: int
+    vote: TxVote
+    senders: set[int] = field(default_factory=set)
+
+
+class TxVotePool:
+    def __init__(self, config: MempoolConfig, height: int = 0, wal_path: str = ""):
+        self.config = config
+        self.height = height
+        self._mtx = threading.RLock()
+        self._cond = threading.Condition(self._mtx)
+        self._seq = 0  # bumps on every accepted vote (consumer wakeups)
+        self._votes: dict[bytes, _PoolVote] = {}  # vote_key -> entry (ordered)
+        self._votes_bytes = 0
+        self.cache = LRUCache(config.cache_size) if config.cache_size > 0 else NopCache()
+        self._txs_available = threading.Event()
+        self._notified_txs_available = False
+        self._notify_available = False
+        self.wal: WAL | None = None
+        if wal_path:
+            self.init_wal(wal_path)
+
+    # -- WAL (reference InitWAL :100-123) --
+
+    def init_wal(self, path: str) -> None:
+        self.wal = WAL(path)
+
+    def replay_wal(self) -> int:
+        """Re-ingest votes from the WAL (crash recovery); returns count."""
+        if self.wal is None:
+            return 0
+        n = 0
+        for payload in self.wal.replay():
+            try:
+                vote = decode_tx_vote(payload)
+            except Exception:
+                continue
+            try:
+                self.check_tx(vote, write_wal=False)
+                n += 1
+            except (ErrTxInCache, ErrMempoolIsFull, ErrTxTooLarge):
+                continue
+        return n
+
+    def close_wal(self) -> None:
+        if self.wal is not None:
+            self.wal.close()
+            self.wal = None
+
+    # -- introspection --
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._votes)
+
+    def txs_bytes(self) -> int:
+        with self._mtx:
+            return self._votes_bytes
+
+    def txs_available(self) -> threading.Event:
+        self._notify_available = True
+        return self._txs_available
+
+    def seq(self) -> int:
+        """Monotonic ingest counter; pairs with wait_for_new."""
+        with self._mtx:
+            return self._seq
+
+    def wait_for_new(self, last_seq: int, timeout: float) -> int:
+        """Block until a vote arrives after last_seq (or timeout); returns
+        the current seq. The engine idles on this instead of spinning —
+        unlike txs_available it fires on EVERY accepted vote, not once per
+        height."""
+        with self._cond:
+            if self._seq == last_seq:
+                self._cond.wait(timeout)
+            return self._seq
+
+    def enable_txs_available(self) -> None:
+        self._notify_available = True
+
+    def has(self, key: bytes) -> bool:
+        with self._mtx:
+            return key in self._votes
+
+    def has_sender(self, key: bytes, sender_id: int) -> bool:
+        with self._mtx:
+            entry = self._votes.get(key)
+            return entry is not None and sender_id in entry.senders
+
+    # -- ingest (reference CheckTx/CheckTxWithInfo :180-261) --
+
+    def check_tx(
+        self, vote: TxVote, tx_info: TxInfo | None = None, write_wal: bool = True
+    ) -> None:
+        """Raises on rejection; returns None when the vote entered the pool."""
+        tx_info = tx_info or TxInfo(UNKNOWN_PEER_ID)
+        encoded = encode_tx_vote(vote)
+        vote_size = len(encoded)
+        with self._mtx:
+            if (
+                len(self._votes) >= self.config.size
+                or vote_size + self._votes_bytes > self.config.max_txs_bytes
+            ):
+                raise ErrMempoolIsFull(
+                    len(self._votes),
+                    self.config.size,
+                    self._votes_bytes,
+                    self.config.max_txs_bytes,
+                )
+            max_size = self.config.max_msg_bytes - _MSG_OVERHEAD
+            if vote_size > max_size:
+                raise ErrTxTooLarge(max_size, vote_size)
+            key = vote_key(vote)
+            if not self.cache.push(key):
+                entry = self._votes.get(key)
+                if entry is not None:
+                    entry.senders.add(tx_info.sender_id)
+                raise ErrTxInCache()
+            if self.wal is not None and write_wal:
+                self.wal.write(encoded)
+            entry = _PoolVote(self.height, vote, {tx_info.sender_id})
+            self._votes[key] = entry
+            self._votes_bytes += vote_size
+            self._seq += 1
+            self._cond.notify_all()
+            self._notify_txs_available()
+
+    def _notify_txs_available(self) -> None:
+        if self._notify_available and not self._notified_txs_available:
+            self._notified_txs_available = True
+            self._txs_available.set()
+
+    # -- consumption --
+
+    def reap_max_txs(self, max_: int) -> list[TxVote]:
+        with self._mtx:
+            if max_ < 0:
+                max_ = len(self._votes)
+            return [e.vote for e in list(self._votes.values())[:max_]]
+
+    def drain_batch(self, max_: int, skip: set[bytes] | None = None) -> list[tuple[bytes, TxVote]]:
+        """Snapshot up to max_ (key, vote) pairs in order, skipping keys."""
+        out = []
+        with self._mtx:
+            for k, e in self._votes.items():
+                if skip is not None and k in skip:
+                    continue
+                out.append((k, e.vote))
+                if len(out) >= max_:
+                    break
+        return out
+
+    def entries(self, after: int = 0, limit: int = -1) -> list[tuple[bytes, TxVote]]:
+        """Snapshot of (key, vote) pairs in insertion order (gossip walk)."""
+        with self._mtx:
+            items = [(k, e.vote) for k, e in self._votes.items()]
+        if limit >= 0:
+            return items[after : after + limit]
+        return items[after:]
+
+    def remove(self, keys: list[bytes], cache_too: bool = False) -> None:
+        """Remove votes by key (quorum purge path)."""
+        with self._mtx:
+            for k in keys:
+                entry = self._votes.pop(k, None)
+                if entry is not None:
+                    self._votes_bytes -= len(encode_tx_vote(entry.vote))
+                if cache_too:
+                    self.cache.remove(k)
+
+    # -- update on commit (reference Update :329-359) --
+
+    def update(self, height: int, votes: list[TxVote]) -> None:
+        with self._mtx:
+            self.height = height
+            self._notified_txs_available = False
+            self._txs_available.clear()
+            for v in votes:
+                k = vote_key(v)
+                self.cache.push(k)  # committed votes stay cached
+                entry = self._votes.pop(k, None)
+                if entry is not None:
+                    self._votes_bytes -= len(encode_tx_vote(entry.vote))
+            if len(self._votes) > 0:
+                self._notify_txs_available()
+
+    def flush(self) -> None:
+        with self._mtx:
+            self._votes.clear()
+            self._votes_bytes = 0
+            self.cache.reset()

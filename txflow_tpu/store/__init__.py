@@ -1,0 +1,6 @@
+"""Durable stores over a KV database (reference tx/, store/, state/store.go)."""
+
+from .db import DB, FileDB, MemDB
+from .tx_store import TxStore
+
+__all__ = ["DB", "FileDB", "MemDB", "TxStore"]

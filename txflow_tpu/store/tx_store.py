@@ -1,0 +1,112 @@
+"""TxStore: durable store of fast-path-committed transactions.
+
+Reference tx/store.go:28-163 — rows keyed ``H:<txhash>`` (the TxVoteSet)
+and ``C:<txhash>`` (the Commit certificate), plus a height-watermark JSON
+under ``TxStoreHeight``. Values here use the framework's deterministic
+codec (votes are amino-compatible; the envelope is length-prefixed
+concatenation) — the storage format is node-internal in the reference too.
+Load methods raise on undecodable rows (probable disk corruption), like
+the reference's panics.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from ..codec import amino
+from ..types import Commit, CommitSig, TxVote, TxVoteSet, decode_tx_vote, encode_tx_vote
+from ..types.validator import ValidatorSet
+from .db import DB
+
+_HEIGHT_KEY = b"TxStoreHeight"
+
+
+def _tx_key(tx_hash: str) -> bytes:
+    return b"H:" + tx_hash.encode()
+
+
+def _commit_key(tx_hash: str) -> bytes:
+    return b"C:" + tx_hash.encode()
+
+
+def _encode_votes(votes: list[TxVote]) -> bytes:
+    out = bytearray()
+    for v in votes:
+        out += amino.length_prefixed(encode_tx_vote(v))
+    return bytes(out)
+
+
+def _decode_votes(data: bytes) -> list[TxVote]:
+    votes, off = [], 0
+    while off < len(data):
+        ln, off = amino.read_uvarint(data, off)
+        votes.append(decode_tx_vote(data[off : off + ln]))
+        off += ln
+    return votes
+
+
+class TxStore:
+    def __init__(self, db: DB):
+        self.db = db
+        self._mtx = threading.Lock()
+        self._height = self._load_height()
+
+    def _load_height(self) -> int:
+        raw = self.db.get(_HEIGHT_KEY)
+        if raw is None:
+            return 0
+        return json.loads(raw)["height"]
+
+    def height(self) -> int:
+        with self._mtx:
+            return self._height
+
+    # -- save (reference :83-107) --
+
+    def save_tx(self, vote_set: TxVoteSet, commit: Commit | None = None) -> None:
+        if vote_set is None:
+            raise ValueError("TxStore can only save a non-nil TxVoteSet")
+        tx_hash = vote_set.tx_hash
+        with self._mtx:
+            self.db.set(_tx_key(tx_hash), _encode_votes(vote_set.get_votes()))
+            if commit is None and vote_set.has_two_thirds_majority():
+                commit = vote_set.make_commit()
+            if commit is not None:
+                self.db.set(
+                    _commit_key(tx_hash),
+                    _encode_votes([cs.to_vote() for cs in commit.commits]),
+                )
+            h = vote_set.height()
+            if h > self._height:
+                self._height = h
+            self.db.set_sync(_HEIGHT_KEY, json.dumps({"height": self._height}).encode())
+
+    # -- load (reference :54-80) --
+
+    def load_tx_votes(self, tx_hash: str) -> list[TxVote] | None:
+        """The saved votes for a tx hash, or None if unknown."""
+        raw = self.db.get(_tx_key(tx_hash))
+        if raw is None:
+            return None
+        return _decode_votes(raw)
+
+    def load_tx(self, tx_hash: str, chain_id: str, val_set: ValidatorSet) -> TxVoteSet | None:
+        """Rebuild the TxVoteSet (the reference deserializes it directly)."""
+        votes = self.load_tx_votes(tx_hash)
+        if votes is None:
+            return None
+        vs = TxVoteSet(chain_id, votes[0].height if votes else 0, tx_hash, votes[0].tx_key if votes else b"", val_set)
+        for v in votes:
+            vs.add_verified_vote(v)
+        return vs
+
+    def load_tx_commit(self, tx_hash: str) -> Commit | None:
+        raw = self.db.get(_commit_key(tx_hash))
+        if raw is None:
+            return None
+        votes = _decode_votes(raw)
+        return Commit(tx_hash, [CommitSig.from_vote(v) for v in votes])
+
+    def has_tx(self, tx_hash: str) -> bool:
+        return self.db.has(_tx_key(tx_hash))

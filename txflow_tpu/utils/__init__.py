@@ -1,0 +1,1 @@
+"""Host-side utilities: WAL, caches, events, config, metrics, logging."""

@@ -1,0 +1,66 @@
+"""Fixed-size LRU set (reference txvotepool ``mapTxCache``, :388-451).
+
+push() returns False when the key is already cached (the pool's dedup
+signal); at capacity the oldest entry is evicted — identical observable
+behavior to the reference's map+list implementation, via OrderedDict.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+
+class LRUCache:
+    def __init__(self, size: int):
+        if size <= 0:
+            raise ValueError("cache size must be positive")
+        self.size = size
+        self._mtx = threading.Lock()
+        self._map: OrderedDict[bytes, None] = OrderedDict()
+
+    def push(self, key: bytes) -> bool:
+        """Add key; returns False if it was already present (and refreshes it)."""
+        with self._mtx:
+            if key in self._map:
+                self._map.move_to_end(key)
+                return False
+            if len(self._map) >= self.size:
+                self._map.popitem(last=False)
+            self._map[key] = None
+            return True
+
+    def remove(self, key: bytes) -> None:
+        with self._mtx:
+            self._map.pop(key, None)
+
+    def reset(self) -> None:
+        with self._mtx:
+            self._map.clear()
+
+    def __contains__(self, key: bytes) -> bool:
+        with self._mtx:
+            return key in self._map
+
+    def __len__(self) -> int:
+        with self._mtx:
+            return len(self._map)
+
+
+class NopCache:
+    """Cache disabled (config.cache_size = 0): everything is new."""
+
+    def push(self, key: bytes) -> bool:
+        return True
+
+    def remove(self, key: bytes) -> None:
+        pass
+
+    def reset(self) -> None:
+        pass
+
+    def __contains__(self, key: bytes) -> bool:
+        return False
+
+    def __len__(self) -> int:
+        return 0

@@ -1,0 +1,127 @@
+"""Configuration (reference: tendermint TOML ``cfg.Config`` passed to NewNode).
+
+Defaults mirror tendermint v0.31.2's: mempool size/caps
+(txvotepool/txvotepool.go:198-208 reads config.Mempool), consensus timeouts
+(consensus/state.go:809-816), instrumentation toggles. Plain dataclasses —
+load/save as JSON or TOML-ish dicts; no CLI layer exists in the reference
+(it is a library), and none is required here.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, asdict
+
+
+@dataclass
+class MempoolConfig:
+    size: int = 5000
+    max_txs_bytes: int = 1024 * 1024 * 1024  # 1GB
+    cache_size: int = 10000
+    max_msg_bytes: int = 1024 * 1024  # max gossip msg (consensus/reactor.go:28)
+    broadcast: bool = True
+    wal_dir: str = ""  # empty = WAL disabled
+
+    @property
+    def wal_enabled(self) -> bool:
+        return self.wal_dir != ""
+
+
+@dataclass
+class ConsensusConfig:
+    # all in seconds (reference uses ms in TOML)
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    timeout_commit: float = 1.0
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: float = 0.0
+    peer_gossip_sleep: float = 0.1
+    peer_query_maj23_sleep: float = 2.0
+    wal_dir: str = ""
+
+    def propose_timeout(self, round_: int) -> float:
+        return self.timeout_propose + self.timeout_propose_delta * round_
+
+    def prevote_timeout(self, round_: int) -> float:
+        return self.timeout_prevote + self.timeout_prevote_delta * round_
+
+    def precommit_timeout(self, round_: int) -> float:
+        return self.timeout_precommit + self.timeout_precommit_delta * round_
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    persistent_peers: str = ""
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    send_rate: int = 5 * 1024 * 1024
+    recv_rate: int = 5 * 1024 * 1024
+    flush_throttle: float = 0.1
+    pex: bool = True
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://127.0.0.1:26657"
+    max_open_connections: int = 900
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    namespace: str = "txflow"
+
+
+@dataclass
+class EngineConfig:
+    """Fast-path aggregation engine (no reference analog; device batching).
+
+    The reference processes votes one at a time (txflow/service.go:123-166);
+    these knobs govern the batched device pipeline that replaces it.
+    """
+
+    max_batch: int = 16384  # votes per device step
+    max_slots: int = 4096  # concurrent in-flight txs per step
+    use_device: bool = True  # False = scalar golden verifier (debug)
+    poll_interval: float = 0.002  # seconds to wait when the pool is empty
+
+
+@dataclass
+class Config:
+    chain_id: str = "txflow-chain"
+    root_dir: str = ""
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    def db_dir(self) -> str:
+        return os.path.join(self.root_dir, "data") if self.root_dir else ""
+
+
+def test_config(root_dir: str = "") -> Config:
+    """Fast-timeout config for tests (reference cfg.ResetTestRoot)."""
+    c = Config(root_dir=root_dir)
+    c.consensus.timeout_propose = 0.4
+    c.consensus.timeout_propose_delta = 0.2
+    c.consensus.timeout_prevote = 0.2
+    c.consensus.timeout_prevote_delta = 0.2
+    c.consensus.timeout_precommit = 0.2
+    c.consensus.timeout_precommit_delta = 0.2
+    c.consensus.timeout_commit = 0.1
+    c.consensus.skip_timeout_commit = True
+    c.consensus.peer_gossip_sleep = 0.005
+    c.mempool.cache_size = 1000
+    return c

@@ -1,0 +1,57 @@
+"""Failpoints for crash-consistency testing (reference: libs/fail).
+
+The reference compiles ``fail.Fail()`` into the commit paths
+(txflowstate/execution.go:87,95, state/execution.go, consensus/state.go)
+and triggers them via env var. Here: named points armed programmatically
+(tests) or via TXFLOW_FAIL=<name>[:<count>] in the environment; firing
+raises ``FailpointError`` after the arm count reaches zero.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class FailpointError(RuntimeError):
+    pass
+
+
+_mtx = threading.Lock()
+_armed: dict[str, int] = {}
+
+
+def _load_env() -> None:
+    spec = os.environ.get("TXFLOW_FAIL", "")
+    if not spec:
+        return
+    name, _, cnt = spec.partition(":")
+    _armed.setdefault(name, int(cnt) if cnt else 0)
+
+
+_load_env()
+
+
+def arm(name: str, after: int = 0) -> None:
+    """Arm a failpoint to fire on the (after+1)-th hit."""
+    with _mtx:
+        _armed[name] = after
+
+
+def disarm(name: str | None = None) -> None:
+    with _mtx:
+        if name is None:
+            _armed.clear()
+        else:
+            _armed.pop(name, None)
+
+
+def fail(name: str) -> None:
+    with _mtx:
+        if name not in _armed:
+            return
+        if _armed[name] > 0:
+            _armed[name] -= 1
+            return
+        del _armed[name]
+    raise FailpointError(f"failpoint {name} fired")

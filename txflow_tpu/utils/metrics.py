@@ -1,0 +1,140 @@
+"""Prometheus-style metrics (reference: go-kit metrics per subsystem).
+
+Mirrors the surface of consensus/metrics.go, txflowstate/metrics.go and the
+mempool metrics: Gauge / Counter / Histogram with label support, a process
+registry, and a text exposition dump compatible with the Prometheus format
+served at the instrumentation endpoint (node/node.go:988-1007).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._mtx = threading.Lock()
+
+
+class Gauge(_Metric):
+    def __init__(self, name: str, help_: str = ""):
+        super().__init__(name, help_)
+        self._v = 0.0
+
+    def set(self, v: float) -> None:
+        with self._mtx:
+            self._v = v
+
+    def add(self, v: float) -> None:
+        with self._mtx:
+            self._v += v
+
+    def value(self) -> float:
+        with self._mtx:
+            return self._v
+
+    def expose(self) -> str:
+        return f"# TYPE {self.name} gauge\n{self.name} {self.value()}\n"
+
+
+class Counter(_Metric):
+    def __init__(self, name: str, help_: str = ""):
+        super().__init__(name, help_)
+        self._v = 0.0
+
+    def add(self, v: float = 1.0) -> None:
+        with self._mtx:
+            self._v += v
+
+    def value(self) -> float:
+        with self._mtx:
+            return self._v
+
+    def expose(self) -> str:
+        return f"# TYPE {self.name} counter\n{self.name} {self.value()}\n"
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram (sum/count + cumulative buckets)."""
+
+    DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
+
+    def __init__(self, name: str, help_: str = "", buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_)
+        self.buckets = tuple(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        with self._mtx:
+            self._sum += v
+            self._count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def expose(self) -> str:
+        with self._mtx:
+            lines = [f"# TYPE {self.name} histogram"]
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += self._counts[i]
+                lines.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+            cum += self._counts[-1]
+            lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{self.name}_sum {self._sum}")
+            lines.append(f"{self.name}_count {self._count}")
+            return "\n".join(lines) + "\n"
+
+
+class Registry:
+    def __init__(self, namespace: str = "txflow"):
+        self.namespace = namespace
+        self._mtx = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _reg(self, cls, subsystem: str, name: str, help_: str, **kw):
+        full = f"{self.namespace}_{subsystem}_{name}"
+        with self._mtx:
+            m = self._metrics.get(full)
+            if m is None:
+                m = cls(full, help_, **kw)
+                self._metrics[full] = m
+            return m
+
+    def gauge(self, subsystem: str, name: str, help_: str = "") -> Gauge:
+        return self._reg(Gauge, subsystem, name, help_)
+
+    def counter(self, subsystem: str, name: str, help_: str = "") -> Counter:
+        return self._reg(Counter, subsystem, name, help_)
+
+    def histogram(self, subsystem: str, name: str, help_: str = "", buckets=Histogram.DEFAULT_BUCKETS) -> Histogram:
+        return self._reg(Histogram, subsystem, name, help_, buckets=buckets)
+
+    def expose(self) -> str:
+        with self._mtx:
+            return "".join(m.expose() for m in self._metrics.values())
+
+
+GLOBAL = Registry()
+
+
+class TxFlowMetrics:
+    """Fast-path metrics (reference txflowstate/metrics.go:17-45)."""
+
+    def __init__(self, registry: Registry | None = None):
+        r = registry or GLOBAL
+        self.height = r.gauge("txflow", "height", "committed fast-path height")
+        self.committed_txs = r.counter("txflow", "committed_txs", "txs committed via fast path")
+        self.committed_votes = r.counter("txflow", "committed_votes", "votes in committed quorums")
+        self.verified_votes = r.counter("txflow", "verified_votes", "signatures batch-verified")
+        self.invalid_votes = r.counter("txflow", "invalid_votes", "votes failing verification")
+        self.batch_size = r.histogram("txflow", "batch_size", "device batch occupancy", buckets=(64, 256, 1024, 4096, 16384, 65536))
+        self.step_time = r.histogram("txflow", "step_seconds", "aggregation step wall time")
+        self.tx_processing_time = r.histogram("txflow", "tx_processing_seconds", "ApplyTx wall time")

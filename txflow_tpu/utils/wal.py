@@ -1,0 +1,73 @@
+"""Append-only write-ahead log with CRC framing.
+
+Replaces the reference's autofile group WAL (tendermint libs/autofile, used
+by txvotepool/txvotepool.go:100-123 and the consensus WAL). Frame format:
+``crc32(payload) u32 | len(payload) u32 | payload`` — torn tails are
+detected and truncated on replay, which is the crash-consistency property
+the reference's tests assert via checksum (txvotepool_test.go:253) and
+crashingWAL (consensus/replay_test.go:113-180).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Iterator
+
+_HDR = struct.Struct("<II")
+
+
+class WAL:
+    def __init__(self, path: str, sync: bool = False):
+        self.path = path
+        self.sync_on_write = sync
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "ab")
+
+    def write(self, payload: bytes) -> None:
+        frame = _HDR.pack(zlib.crc32(payload), len(payload)) + payload
+        self._f.write(frame)
+        if self.sync_on_write:
+            self.flush_and_sync()
+
+    def write_sync(self, payload: bytes) -> None:
+        self.write(payload)
+        self.flush_and_sync()
+
+    def flush_and_sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+    @property
+    def size(self) -> int:
+        self._f.flush()
+        return os.path.getsize(self.path)
+
+    def replay(self) -> Iterator[bytes]:
+        """Yield intact frames; stop (and truncate) at the first torn one."""
+        self._f.flush()
+        good_end = 0
+        with open(self.path, "rb") as f:
+            while True:
+                hdr = f.read(_HDR.size)
+                if len(hdr) < _HDR.size:
+                    break
+                crc, length = _HDR.unpack(hdr)
+                payload = f.read(length)
+                if len(payload) < length or zlib.crc32(payload) != crc:
+                    break
+                good_end = f.tell()
+                yield payload
+        if good_end < os.path.getsize(self.path):
+            # torn tail from a crash mid-append: drop it so future appends
+            # start at a frame boundary
+            self._f.close()
+            with open(self.path, "r+b") as f:
+                f.truncate(good_end)
+            self._f = open(self.path, "ab")

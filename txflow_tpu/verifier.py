@@ -37,10 +37,15 @@ DEFAULT_BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
 
 
 def bucket_size(n: int, buckets=DEFAULT_BUCKETS, multiple: int = 1) -> int:
-    """Smallest bucket >= n (rounded up to `multiple` for mesh divisibility)."""
+    """Smallest bucket >= n, rounded up to `multiple` for mesh divisibility.
+
+    The bucket is chosen first and then rounded, so a non-power-of-two mesh
+    (e.g. 6 devices) still yields one stable shape per bucket instead of a
+    fresh shape per batch size.
+    """
     for b in buckets:
-        if b % multiple == 0 and b >= n:
-            return b
+        if b >= n:
+            return ((b + multiple - 1) // multiple) * multiple
     # beyond the largest bucket: round up to a multiple
     return ((n + multiple - 1) // multiple) * multiple
 
@@ -142,18 +147,24 @@ class DeviceVoteVerifier:
             )
         self.buckets = buckets
         self.mesh = mesh
+        import jax
+
         if mesh is not None:
-            from .parallel.mesh import sharded_verify_and_tally
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from .parallel.mesh import sharded_compact_step
 
             self._n_shards = mesh.size
-            self._fn = sharded_verify_and_tally(mesh)
+            self._fn = sharded_compact_step(mesh)
+            # pre-replicate the per-epoch device constants across the mesh
+            rep = NamedSharding(mesh, PartitionSpec())
+            self._tables_dev = jax.device_put(self.epoch.tables, rep)
+            self._powers_dev = jax.device_put(self._powers, rep)
         else:
-            import jax
-
             self._n_shards = 1
-            self._fn = jax.jit(
-                tally.verify_and_tally(ed25519_batch.verify_kernel)
-            )
+            self._fn = jax.jit(tally.compact_step())
+            self._tables_dev = self.epoch.device_tables()
+            self._powers_dev = jax.numpy.asarray(self._powers)
 
     def verify_and_tally(
         self,
@@ -170,35 +181,38 @@ class DeviceVoteVerifier:
         tx_slot = np.asarray(tx_slot, dtype=np.int32)
         keep = first_occurrence_mask(tx_slot, val_idx)
         b = bucket_size(n, self.buckets, multiple=self._n_shards)
+        # n_slots is a compiled shape too (prior_stake) — bucket it as well,
+        # or every step with a new in-flight tx count would recompile the
+        # whole kernel; padding slots receive no votes and slice away
+        b_slots = bucket_size(n_slots, self.buckets)
 
-        batch = ed25519_batch.prepare_batch(msgs, sigs, val_idx, self.epoch)
+        batch = ed25519_batch.prepare_compact(msgs, sigs, val_idx, self.epoch)
         batch.pre_ok &= keep
         # pad to bucket: pre_ok False + slot -1 => contributes nothing
         pad = b - n
         s_nib = _pad(batch.s_nibbles, pad)
         h_nib = _pad(batch.h_nibbles, pad)
-        a_tab = _pad(batch.a_tables, pad)
+        vidx = _pad(batch.val_idx, pad)
         r_y = _pad(batch.r_y, pad)
         r_sign = _pad(batch.r_sign, pad)
         pre_ok = _pad(batch.pre_ok, pad)
         slot = np.full(b, -1, np.int32)
         slot[:n] = tx_slot
-        power = np.zeros(b, np.int32)
-        in_range = (val_idx >= 0) & (val_idx < len(self._powers))
-        power[:n] = np.where(in_range, self._powers[np.clip(val_idx, 0, max(len(self._powers) - 1, 0))], 0)
 
-        prior = (
-            np.zeros(n_slots, np.int32)
-            if prior_stake is None
-            else np.asarray(prior_stake, dtype=np.int32)
-        )
+        prior = np.zeros(b_slots, np.int32)
+        if prior_stake is not None:
+            prior[:n_slots] = np.asarray(prior_stake, dtype=np.int32)
         q = np.int32(self.val_set.quorum_power() if quorum is None else quorum)
 
         valid, stake, maj23 = self._fn(
-            (s_nib, h_nib, a_tab, r_y, r_sign, pre_ok), slot, power, prior, q
+            s_nib, h_nib, vidx, r_y, r_sign, pre_ok, slot,
+            self._tables_dev, self._powers_dev, prior, q,
         )
         return TallyResult(
-            np.asarray(valid)[:n], np.asarray(stake), np.asarray(maj23), ~keep
+            np.asarray(valid)[:n],
+            np.asarray(stake)[:n_slots],
+            np.asarray(maj23)[:n_slots],
+            ~keep,
         )
 
 
