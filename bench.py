@@ -1,86 +1,221 @@
-"""North-star benchmark: committed TxVotes/sec through the batched verifier.
+"""North-star benchmark: BASELINE config 1, measured end to end.
 
-Measurement protocol per BASELINE.json config 1-2: a 4-validator set,
-pregenerated signed TxVotes (4 votes per tx — every commit decision needs
-a full honest quorum at equal stake: quorum = floor(40*2/3)+1 = 27 > 3*10),
-replayed through the device verify+tally path in fixed-size batches. The
-measured rate counts verified-and-tallied votes per second of sustained
-wall-clock, including per-batch host prep (sig parsing, SHA-512 folding,
-scalar decomposition, table gather) and the D2H readback of the
-valid/stake/maj23 masks — i.e. everything between "votes in the pool" and
-"quorum decision on host".
+Protocol (BASELINE.json config 1-2): an N-validator in-process network —
+every node runs the full fast path (txvotepool -> batched device
+verify+tally -> TxStore persist -> kvstore ABCI execute -> pool purge ->
+commitpool) over real gossip reactors wired with in-memory pipes. Txs are
+pre-seeded into every mempool and TxVotes are PREGENERATED (signing sits
+outside the timed loop, per the config's "pregenerated TxVotes replayed
+through txvotepool"); the timed phase streams each validator's votes into
+its own node's vote pool in chunks, vote gossip fans them out, and every
+node independently verifies, tallies, and commits every tx.
 
-Baseline: the reference's hot path is one pure-Go ed25519 verify per vote,
-single-threaded (reference txflow/service.go:123-166, ~50-100us/verify =>
-~10-20k votes/s/core; BASELINE.md). vs_baseline is measured against the
-generous end of that ceiling, 20,000 votes/s.
+Metric: committed TxVotes/sec summed over nodes (votes inside commit
+certificates persisted to TxStores) + p50 tx-commit latency (vote-chunk
+injection -> per-node commit event). Baseline: the reference's hot path is
+one pure-Go ed25519 verify per vote, single-threaded (reference
+txflow/service.go:123-166, ~50-100us/verify => 10-20k votes/s/core;
+BASELINE.md). vs_baseline measures against the generous end, 20,000/s.
 
-Prints exactly one JSON line.
+Robustness contract with the driver: prints EXACTLY ONE JSON line on
+stdout no matter what. The TPU backend is probed in a subprocess first
+(round 1 recorded both an UNAVAILABLE init failure and a multi-minute
+init hang); on probe failure the bench falls back to CPU and says so in
+the JSON.
 """
 
 import hashlib
 import json
 import os
+import statistics
+import subprocess
 import sys
 import time
 
-import numpy as np
+
+def _resolve_platform() -> str:
+    """Probe the default JAX backend in a subprocess; fall back to CPU.
+
+    The probe has a hard timeout so a hanging TPU client (round-1
+    MULTICHIP artifact) cannot eat the driver's whole budget, and it runs
+    twice because a previous holder of the chip may need a moment to die.
+    """
+    if os.environ.get("BENCH_PLATFORM"):
+        plat = os.environ["BENCH_PLATFORM"]
+        if plat == "cpu":
+            _force_cpu()
+        return plat
+    probe = "import jax; jax.devices(); print(jax.default_backend())"
+    for attempt in range(2):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True,
+                text=True,
+                timeout=180,
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                return r.stdout.strip().splitlines()[-1]
+        except subprocess.TimeoutExpired:
+            pass
+        print(f"bench: TPU probe attempt {attempt + 1} failed", file=sys.stderr)
+        time.sleep(3)
+    _force_cpu()
+    return "cpu"
+
+
+def _force_cpu() -> None:
+    """Pin this process to the CPU backend.
+
+    The environment's PJRT site hook can pre-register the TPU platform and
+    ignore the JAX_PLATFORMS env var, so the pin must also go through
+    jax.config after import — BEFORE any backend is created (a TPU client
+    init here can hang for minutes)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 
 BASELINE_VOTES_PER_SEC = 20_000.0  # reference CPU ceiling, BASELINE.md
-CHAIN_ID = "txflow-bench"
+
+
+def run_bench(platform: str) -> dict:
+    from txflow_tpu.node import LocalNet
+    from txflow_tpu.types import TxVote
+    from txflow_tpu.utils.events import EventTx
+
+    n_vals = int(os.environ.get("BENCH_VALIDATORS", "4"))
+    # On the CPU fallback the TPU-shaped curve kernel is ~100x slower than
+    # host crypto, so the bench drops to the framework's documented
+    # fallback rung (SURVEY §7 hard-part 1): the scalar host verifier
+    # behind the same VoteVerifier interface, with a smaller corpus.
+    on_cpu = platform == "cpu"
+    verifier_kind = os.environ.get("BENCH_VERIFIER", "scalar" if on_cpu else "device")
+    n_txs = int(os.environ.get("BENCH_TXS", "512" if on_cpu else "2048"))
+    chunk = int(os.environ.get("BENCH_CHUNK", "512"))
+    warm_txs = min(64 if on_cpu else 256, n_txs)
+
+    net = LocalNet(
+        n_vals,
+        chain_id="txflow-bench",
+        use_device_verifier=verifier_kind == "device",
+        sign=False,  # pregenerated-vote replay: no signTxRoutine
+        mempool_broadcast=False,  # txs are pre-seeded on every node
+    )
+
+    # -- pregenerate txs + every validator's votes (untimed) --
+    def make_corpus(tag: str, count: int):
+        txs = [b"%s-%d=v" % (tag.encode(), i) for i in range(count)]
+        votes_by_val: list[list[TxVote]] = [[] for _ in range(n_vals)]
+        for tx in txs:
+            tx_key = hashlib.sha256(tx).digest()
+            tx_hash = tx_key.hex().upper()
+            for vi, pv in enumerate(net.priv_vals):
+                vote = TxVote(
+                    height=0,
+                    tx_hash=tx_hash,
+                    tx_key=tx_key,
+                    validator_address=pv.get_address(),
+                )
+                pv.sign_tx_vote("txflow-bench", vote)
+                votes_by_val[vi].append(vote)
+        return txs, votes_by_val
+
+    warm_corpus = make_corpus("warm", warm_txs)
+    main_corpus = make_corpus("tx", n_txs)
+
+    # commit-latency probes: per node, tx_hash -> commit wall time
+    commit_times: list[dict[str, float]] = [dict() for _ in net.nodes]
+
+    def make_cb(idx):
+        def cb(ev):
+            commit_times[idx][ev.data.tx_hash] = time.perf_counter()
+
+        return cb
+
+    for i, node in enumerate(net.nodes):
+        node.event_bus.subscribe_callback(EventTx, make_cb(i))
+
+    net.start()
+
+    def seed_and_replay(txs, votes_by_val, chunk_size):
+        """Seed txs everywhere, then stream votes in chunks; returns
+        (wall_seconds, inject_time per tx_hash)."""
+        for node in net.nodes:
+            for tx in txs:
+                node.mempool.check_tx(tx)
+        inject_t: dict[str, float] = {}
+        t0 = time.perf_counter()
+        for base in range(0, len(txs), chunk_size):
+            t_chunk = time.perf_counter()
+            for vi, node in enumerate(net.nodes):
+                pool = node.tx_vote_pool
+                for vote in votes_by_val[vi][base : base + chunk_size]:
+                    if vi == 0:
+                        inject_t[vote.tx_hash] = t_chunk
+                    try:
+                        pool.check_tx(vote)
+                    except Exception:
+                        pass
+        ok = net.wait_all_committed(txs, timeout=600.0)
+        wall = time.perf_counter() - t0
+        if not ok:
+            raise RuntimeError("timeout waiting for commits")
+        return wall, inject_t
+
+    # warmup: compiles every kernel shape + exercises the full pipeline
+    seed_and_replay(*warm_corpus, chunk)
+    warm_committed = net.committed_votes_total()
+
+    wall, inject_t = seed_and_replay(*main_corpus, chunk)
+    committed = net.committed_votes_total() - warm_committed
+
+    lat_ms = []
+    for times in commit_times:
+        for tx_hash, t_inj in inject_t.items():
+            t_c = times.get(tx_hash)
+            if t_c is not None:
+                lat_ms.append((t_c - t_inj) * 1e3)
+    p50 = statistics.median(lat_ms) if lat_ms else float("nan")
+
+    net.stop()
+    votes_per_sec = committed / wall
+    return {
+        "metric": "committed_txvotes_per_sec",
+        "value": round(votes_per_sec, 1),
+        "unit": "votes/s",
+        "vs_baseline": round(votes_per_sec / BASELINE_VOTES_PER_SEC, 3),
+        "p50_commit_latency_ms": round(p50, 2),
+        "platform": platform,
+        "verifier": verifier_kind,
+        "validators": n_vals,
+        "txs": n_txs,
+        "committed_votes": committed,
+        "wall_s": round(wall, 3),
+    }
 
 
 def main():
-    from txflow_tpu.crypto import ed25519 as host_ed
-    from txflow_tpu.types import Validator, ValidatorSet, canonical_sign_bytes
-    from txflow_tpu.verifier import DeviceVoteVerifier
-
-    n_vals = int(os.environ.get("BENCH_VALIDATORS", "4"))
-    batch = int(os.environ.get("BENCH_BATCH", "4096"))
-    iters = int(os.environ.get("BENCH_ITERS", "8"))
-
-    seeds = [hashlib.sha256(b"bench-val%d" % i).digest() for i in range(n_vals)]
-    pubs = [host_ed.public_key_from_seed(s) for s in seeds]
-    vals = ValidatorSet([Validator.from_pub_key(p, 10) for p in pubs])
-    seed_by_index = [dict(zip(pubs, seeds))[v.pub_key] for v in vals]
-
-    n_txs = batch // n_vals
-    msgs, sigs, vidx, slot = [], [], [], []
-    for t in range(n_txs):
-        tx_hash = hashlib.sha256(b"bench-tx%d" % t).hexdigest().upper()
-        msg = canonical_sign_bytes(CHAIN_ID, 1, tx_hash, 1700000000_000000000 + t)
-        for vi in range(n_vals):
-            msgs.append(msg)
-            sigs.append(host_ed.sign(seed_by_index[vi], msg))
-            vidx.append(vi)
-            slot.append(t)
-    vidx = np.array(vidx)
-    slot = np.array(slot, np.int32)
-
-    verifier = DeviceVoteVerifier(vals)
-
-    # warmup: compile + correctness gate (commit decisions must be unanimous)
-    r = verifier.verify_and_tally(msgs, sigs, vidx, slot, n_txs)
-    assert r.valid.all(), "bench corpus must verify"
-    assert r.maj23.all(), "full quorum expected on every tx"
-
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        r = verifier.verify_and_tally(msgs, sigs, vidx, slot, n_txs)
-        assert r.maj23.all()
-    dt = time.perf_counter() - t0
-
-    votes_per_sec = iters * len(msgs) / dt
-    print(
-        json.dumps(
-            {
-                "metric": "committed_txvotes_per_sec",
-                "value": round(votes_per_sec, 1),
-                "unit": "votes/s",
-                "vs_baseline": round(votes_per_sec / BASELINE_VOTES_PER_SEC, 3),
-            }
-        )
-    )
+    platform = _resolve_platform()
+    try:
+        result = run_bench(platform)
+    except Exception as e:
+        if platform != "cpu" and os.environ.get("BENCH_PLATFORM") != "cpu":
+            # TPU path failed mid-run: re-exec once on CPU so the driver
+            # still records a real number (flagged by "platform": "cpu").
+            print(f"bench: {platform} run failed ({e}); retrying on CPU", file=sys.stderr)
+            env = dict(os.environ, BENCH_PLATFORM="cpu", JAX_PLATFORMS="cpu")
+            os.execve(sys.executable, [sys.executable] + sys.argv, env)
+        result = {
+            "metric": "committed_txvotes_per_sec",
+            "value": 0.0,
+            "unit": "votes/s",
+            "vs_baseline": 0.0,
+            "error": repr(e)[:300],
+            "platform": platform,
+        }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

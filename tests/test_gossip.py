@@ -1,0 +1,218 @@
+"""Gossip-layer tests (reference txvotepool/reactor_test.go, mempool/reactor_test.go).
+
+Covers: N-node vote/tx convergence over in-memory switches, sender
+suppression, byzantine-vote rejection across the network, peer-stop and
+switch-stop thread hygiene (the reference's leaktest checks).
+"""
+
+import hashlib
+import threading
+import time
+
+import pytest
+
+from txflow_tpu.node import LocalNet
+from txflow_tpu.p2p import (
+    CHANNEL_TXVOTE,
+    Switch,
+    connect_switches,
+    make_connected_switches,
+)
+from txflow_tpu.pool.mempool import Mempool
+from txflow_tpu.pool.txvotepool import TxVotePool, vote_key
+from txflow_tpu.reactors import StateView, TxVoteReactor
+from txflow_tpu.types import TxVote
+from txflow_tpu.types.priv_validator import MockPV
+from txflow_tpu.types.validator import Validator, ValidatorSet
+from txflow_tpu.utils.config import MempoolConfig, test_config
+
+CHAIN_ID = "gossip-test"
+
+
+def _valset(n=4, power=10):
+    pvs = [MockPV(hashlib.sha256(b"gossip%d" % i).digest()) for i in range(n)]
+    vs = ValidatorSet([Validator.from_pub_key(pv.get_pub_key(), power) for pv in pvs])
+    return pvs, vs
+
+
+def _vote(pv, tx: bytes, height=0) -> TxVote:
+    key = hashlib.sha256(tx).digest()
+    v = TxVote(
+        height=height,
+        tx_hash=key.hex().upper(),
+        tx_key=key,
+        validator_address=pv.get_address(),
+    )
+    pv.sign_tx_vote(CHAIN_ID, v)
+    return v
+
+
+def _wait(cond, timeout=10.0, poll=0.01) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(poll)
+    return False
+
+
+def make_vote_switches(n=4):
+    """N switches with vote reactors only (no engine): pure gossip rig."""
+    pvs, vs = _valset(n)
+    pools, mempools = [], []
+
+    def init(i, sw):
+        pool = TxVotePool(MempoolConfig())
+        mp = Mempool(MempoolConfig())
+        pools.append(pool)
+        mempools.append(mp)
+        reactor = TxVoteReactor(
+            lambda: StateView(CHAIN_ID, 0, vs),
+            mp,
+            pool,
+            priv_val=None,  # votes injected directly; no sign routine
+            poll_interval=0.01,
+        )
+        sw.add_reactor("txvote", reactor)
+        return sw
+
+    switches = make_connected_switches(n, init)
+    return switches, pools, mempools, pvs, vs
+
+
+def test_vote_gossip_converges_4_nodes():
+    switches, pools, _, pvs, _ = make_vote_switches(4)
+    try:
+        txs = [b"tx-%d" % i for i in range(25)]
+        votes = [_vote(pvs[i % 4], tx) for i, tx in enumerate(txs)]
+        for v in votes:
+            pools[0].check_tx(v)
+        assert _wait(lambda: all(p.size() == len(votes) for p in pools))
+        # every pool holds exactly the same votes
+        keys = {vote_key(v) for v in votes}
+        for p in pools:
+            assert {k for k, _ in p.entries()} == keys
+    finally:
+        for sw in switches:
+            sw.stop()
+
+
+def test_gossip_sender_suppression():
+    """A vote gossiped by a peer is never echoed back to that peer: after
+    convergence each pool records the vote's sender, and pools stay at
+    exactly one copy (dedup would catch echoes, but senders prove the
+    suppression bookkeeping)."""
+    switches, pools, _, pvs, _ = make_vote_switches(3)
+    try:
+        v = _vote(pvs[0], b"suppress-me")
+        pools[0].check_tx(v)
+        assert _wait(lambda: all(p.size() == 1 for p in pools))
+        k = vote_key(v)
+        # origin pool: sender is UNKNOWN (0); replicas: the real peer id
+        assert pools[0].has_sender(k, 0)
+        for p in pools[1:]:
+            assert not p.has_sender(k, 0)
+    finally:
+        for sw in switches:
+            sw.stop()
+
+
+def test_height_throttle_defers_future_votes():
+    """Votes two heights ahead of a peer are withheld until it catches up
+    (reference 'allow for a lag of 1 block', txvotepool/reactor.go:240)."""
+    switches, pools, _, pvs, _ = make_vote_switches(2)
+    try:
+        # peer height defaults to 0; a height-5 vote must NOT be sent
+        v = _vote(pvs[0], b"future-tx", height=5)
+        pools[0].check_tx(v)
+        time.sleep(0.3)
+        assert pools[1].size() == 0
+        # raise the peer's view of our... of ITS height: node1's reactor
+        # tells node0 its height via MSG_HEIGHT
+        switches[1].reactors["txvote"].broadcast_height(4)
+        assert _wait(lambda: pools[1].size() == 1)
+    finally:
+        for sw in switches:
+            sw.stop()
+
+
+def test_bad_frame_stops_peer():
+    switches, pools, _, pvs, _ = make_vote_switches(2)
+    try:
+        assert switches[0].n_peers() == 1
+        # node1 sends garbage on the vote channel -> node0 stops the peer
+        switches[1].peers()[0].send(CHANNEL_TXVOTE, b"\x01\xff\xff\xff")
+        assert _wait(lambda: switches[0].n_peers() == 0)
+    finally:
+        for sw in switches:
+            sw.stop()
+
+
+def test_byzantine_votes_rejected_across_network():
+    """One validator signs with a wrong chain id: every honest node still
+    commits every tx off the 3 honest votes and tallies the byzantine
+    signature as invalid (reference byzantine pattern, MockPV breakage)."""
+    pvs = [MockPV(hashlib.sha256(b"byz%d" % i).digest()) for i in range(4)]
+    pvs[0].break_tx_vote_signing = True
+    net = LocalNet(4, use_device_verifier=False, priv_vals=pvs)
+    net.start()
+    try:
+        txs = [b"byz-tx-%d=v" % i for i in range(6)]
+        for tx in txs:
+            net.broadcast_tx(tx)
+        assert net.wait_all_committed(txs, timeout=30)
+        byz_addr = pvs[0].get_address()
+        for node in net.nodes:
+            # byzantine validator never appears in any commit certificate
+            for tx in txs:
+                tx_hash = hashlib.sha256(tx).hexdigest().upper()
+                votes = node.tx_store.load_tx_votes(tx_hash)
+                assert votes, tx_hash
+                assert byz_addr not in {v.validator_address for v in votes}
+        # at least the byzantine node itself verified (and rejected) its own
+        # signatures; on other nodes a byz vote may arrive after commit and
+        # be dropped unverified, so only the network-wide count is stable
+        assert sum(n.metrics.invalid_votes.value() for n in net.nodes) > 0
+    finally:
+        net.stop()
+
+
+def test_peer_stop_ends_broadcast_threads():
+    """Reference leaktest: stopping peers/switches must not leak routines."""
+    before = threading.active_count()
+    switches, pools, _, pvs, _ = make_vote_switches(3)
+    # traffic so broadcast threads are live
+    pools[0].check_tx(_vote(pvs[0], b"leak-tx"))
+    assert _wait(lambda: all(p.size() == 1 for p in pools))
+    for sw in switches:
+        sw.stop()
+    assert _wait(lambda: threading.active_count() <= before, timeout=10)
+
+
+def test_localnet_full_path_device():
+    """4 nodes, device verifier, real sign routines: end-to-end commit."""
+    net = LocalNet(4, use_device_verifier=True)
+    net.start()
+    try:
+        txs = [b"dev-%d=v" % i for i in range(8)]
+        for tx in txs:
+            net.broadcast_tx(tx)
+        assert net.wait_all_committed(txs, timeout=240)
+        # commit certificates are quorum-sized (3 of 4 at equal stake)
+        node = net.nodes[0]
+        for tx in txs:
+            tx_hash = hashlib.sha256(tx).hexdigest().upper()
+            votes = node.tx_store.load_tx_votes(tx_hash)
+            assert len(votes) >= 3
+    finally:
+        net.stop()
+
+
+def test_node_clean_stop_no_thread_leak():
+    before = threading.active_count()
+    net = LocalNet(3, use_device_verifier=False)
+    net.start()
+    net.broadcast_tx(b"stop-tx=v")
+    assert net.wait_all_committed([b"stop-tx=v"], timeout=20)
+    net.stop()
+    assert _wait(lambda: threading.active_count() <= before, timeout=10)

@@ -210,3 +210,36 @@ def test_mempool_reap_bytes_and_gas():
     # each tx is 8 bytes, gas 1
     assert pool.reap_max_bytes_max_gas(20, -1) == txs[:2]
     assert pool.reap_max_bytes_max_gas(-1, 4) == txs[:4]
+
+
+def test_ingest_log_compaction_bounds_memory():
+    """The ingest log drops its dead prefix (IngestLogPool._log_compact)
+    while stable cursors keep observing every live entry exactly once."""
+    from txflow_tpu.pool import base as pool_base
+    from txflow_tpu.pool.txvotepool import TxVotePool
+
+    old_threshold = pool_base.COMPACT_THRESHOLD
+    pool_base.COMPACT_THRESHOLD = 16
+    try:
+        pool = TxVotePool(MempoolConfig(size=100000, cache_size=0))
+        cursor, seen = 0, 0
+        for i in range(200):
+            v = TxVote(
+                height=1,
+                tx_hash="AB",
+                tx_key=b"\x00" * 32,
+                validator_address=b"x" * 20,
+                signature=b"sig-%d" % i,
+            )
+            pool.check_tx(v)
+            if i % 3 == 2:
+                items, cursor = pool.entries_from(cursor, limit=1000)
+                seen += len(items)
+                pool.remove([k for k, _, _ in items])
+        items, cursor = pool.entries_from(cursor, limit=1000)
+        seen += len(items)
+        assert seen == 200
+        assert len(pool._log) < 2 * pool_base.COMPACT_THRESHOLD
+        assert pool._log_base > 150
+    finally:
+        pool_base.COMPACT_THRESHOLD = old_threshold

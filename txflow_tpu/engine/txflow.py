@@ -73,7 +73,10 @@ class TxFlow:
         if verifier is not None:
             self.verifier = verifier
         elif self.config.use_device:
-            self.verifier = DeviceVoteVerifier(val_set)
+            try:
+                self.verifier = DeviceVoteVerifier(val_set)
+            except ValueError:  # total power >= 2^30: int32 tally overflow
+                self.verifier = ScalarVoteVerifier(val_set)
         else:
             self.verifier = ScalarVoteVerifier(val_set)
         self._addr_to_idx = {v.address: i for i, v in enumerate(val_set)}
@@ -104,14 +107,43 @@ class TxFlow:
             self._thread = None
 
     def _run(self) -> None:
-        ev = self.tx_vote_pool.txs_available()
+        # Idle on the pool's per-vote sequence counter, NOT the once-per-
+        # height txs_available event: when every pool vote is already in an
+        # in-flight vote set (awaiting quorum) step() returns 0 while the
+        # event stays set, which would spin this loop at 100% CPU. The seq
+        # is sampled before step() so a vote arriving mid-step wakes us
+        # immediately instead of being missed for a poll interval.
         while True:
             with self._mtx:
                 if not self._running:
                     return
+            seq_before = self.tx_vote_pool.seq()
+            self._form_batch()
             processed = self.step()
             if processed == 0:
-                ev.wait(timeout=self.config.poll_interval)
+                self.tx_vote_pool.wait_for_new(
+                    seq_before, timeout=self.config.poll_interval
+                )
+
+    def _form_batch(self) -> None:
+        """Hold up to batch_wait for min_batch pending votes to coalesce.
+
+        Bounded added latency (batch_wait) in exchange for device-sized
+        batches: one kernel call per thousands of votes instead of one per
+        gossip arrival (SURVEY §7 hard-part 5)."""
+        min_batch = self.config.min_batch
+        if min_batch <= 1:
+            return
+        deadline = time.monotonic() + self.config.batch_wait
+        while True:
+            # engine thread is the only _added_keys writer: safe estimate
+            pending = self.tx_vote_pool.size() - len(self._added_keys)
+            remaining = deadline - time.monotonic()
+            if pending >= min_batch or remaining <= 0:
+                return
+            self.tx_vote_pool.wait_for_new(
+                self.tx_vote_pool.seq(), timeout=remaining
+            )
 
     # ---- batched aggregation step ----
 
@@ -139,9 +171,12 @@ class TxFlow:
                     # (rejected) — either way it can never be added
                     drop_now.append(key)
                     continue
-                slot = slot_of.setdefault(vote.tx_hash, len(slot_of))
-                if slot >= self.config.max_slots:
+                if (
+                    vote.tx_hash not in slot_of
+                    and len(slot_of) >= self.config.max_slots
+                ):
                     break  # leave the tail for the next step
+                slot = slot_of.setdefault(vote.tx_hash, len(slot_of))
                 keys.append(key)
                 votes.append(vote)
                 slots.append(slot)
@@ -262,12 +297,26 @@ class TxFlow:
         with self._mtx:
             self.height = height
             if val_set is not self.val_set:
+                # Build the new verifier BEFORE swapping any engine state so
+                # a constructor failure cannot leave val_set/_addr_to_idx
+                # pointing at the new epoch while the verifier still gathers
+                # the old epoch's tables (wrong results, not an error).
+                if isinstance(self.verifier, DeviceVoteVerifier):
+                    try:
+                        verifier = DeviceVoteVerifier(
+                            val_set,
+                            mesh=self.verifier.mesh,
+                            buckets=self.verifier.buckets,
+                        )
+                    except ValueError:
+                        # total power >= 2^30: int32 device tally would
+                        # overflow — documented fallback to the host path
+                        verifier = ScalarVoteVerifier(val_set)
+                else:
+                    verifier = ScalarVoteVerifier(val_set)
                 self.val_set = val_set
                 self._addr_to_idx = {v.address: i for i, v in enumerate(val_set)}
-                if isinstance(self.verifier, DeviceVoteVerifier):
-                    self.verifier = DeviceVoteVerifier(val_set, mesh=self.verifier.mesh)
-                else:
-                    self.verifier = ScalarVoteVerifier(val_set)
+                self.verifier = verifier
 
 
 def _hash_key(tx_hash: str) -> bytes:

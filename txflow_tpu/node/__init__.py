@@ -1,0 +1,14 @@
+"""Node assembly (reference node/node.go) + in-process validator networks.
+
+``Node`` is the composition root wiring stores, pools, reactors, the
+fast-path aggregation engine, and (as later layers land) the block-path
+consensus and RPC surface — the analog of ``node.NewNode``
+(node/node.go:555-765). ``LocalNet`` builds N fully-connected nodes over
+in-memory pipes: the reference's in-process-testnet pattern
+(p2p.MakeConnectedSwitches) used by the BASELINE measurement configs.
+"""
+
+from .node import Node, NodeConfig
+from .localnet import LocalNet
+
+__all__ = ["Node", "NodeConfig", "LocalNet"]

@@ -1,0 +1,188 @@
+"""Node: the composition root (reference node/node.go:555-826).
+
+Assembles, in reference order: DBs/stores -> ABCI proxy connections ->
+event bus -> pools (mempool + commitpool + txvotepool) -> TxExecutor +
+TxFlow -> p2p switch with the mempool/txvote reactors. The reference's
+wiring bug — txvotepool/commitpool reactors created but never
+``AddReactor``'d into the switch (node/node.go:488-505 vs :822) — is
+fixed here: every reactor registers its channel.
+
+The block-path consensus reactor and the RPC listeners attach to the same
+skeleton as those layers land.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass, field
+
+from ..abci.application import Application
+from ..abci.proxy import AppConns
+from ..engine.execution import TxExecutor
+from ..engine.txflow import TxFlow
+from ..p2p import Switch
+from ..pool.mempool import Mempool
+from ..pool.txvotepool import TxVotePool
+from ..reactors import MempoolReactor, StateView, TxVoteReactor
+from ..store.db import MemDB
+from ..store.tx_store import TxStore
+from ..types.priv_validator import PrivValidator
+from ..types.validator import ValidatorSet
+from ..utils.config import Config, EngineConfig
+from ..utils.events import EventBus
+from ..utils.metrics import Registry, TxFlowMetrics
+
+
+@dataclass
+class NodeConfig:
+    """Assembly knobs beyond the TOML-ish Config sections."""
+
+    config: Config = field(default_factory=Config)
+    gossip_batch: int = 4096
+    use_device_verifier: bool = True
+    # per-reactor broadcast toggles (None = follow config.mempool.broadcast)
+    mempool_broadcast: bool | None = None
+    vote_broadcast: bool | None = None
+
+
+class Node:
+    def __init__(
+        self,
+        node_id: str,
+        chain_id: str,
+        val_set: ValidatorSet,
+        app: Application,
+        priv_val: PrivValidator | None = None,
+        node_config: NodeConfig | None = None,
+        tx_store_db=None,
+        verifier=None,
+        mesh=None,
+    ):
+        nc = node_config or NodeConfig()
+        self.node_id = node_id
+        self.chain_id = chain_id
+        self.config = nc.config
+        self.priv_val = priv_val
+
+        # -- replicated-state view (grows into state.State with the block path) --
+        self._state_mtx = threading.Lock()
+        self._last_block_height = 0
+        self._val_set = val_set
+
+        # -- app + proxy (node/node.go:576) --
+        self.app = app
+        self.proxy_app = AppConns(app)
+
+        # -- event bus (node/node.go:585) --
+        self.event_bus = EventBus()
+
+        # -- pools (node/node.go:627-633) --
+        self.mempool = Mempool(self.config.mempool, proxy_app_conn=self.proxy_app.mempool)
+        self.commitpool = Mempool(self.config.mempool)  # fast-committed txs for blocks
+        self.tx_vote_pool = TxVotePool(self.config.mempool)
+
+        # -- stores + executors (node/node.go:645-668) --
+        self.tx_store = TxStore(tx_store_db if tx_store_db is not None else MemDB())
+        # per-node registry: N in-proc nodes must not share counters
+        self.metrics_registry = Registry()
+        self.metrics = TxFlowMetrics(self.metrics_registry)
+        self.tx_executor = TxExecutor(
+            self.proxy_app.consensus, self.mempool, self.event_bus, self.metrics
+        )
+        engine_cfg = EngineConfig(use_device=nc.use_device_verifier)
+        if verifier is None and nc.use_device_verifier and mesh is not None:
+            from ..verifier import DeviceVoteVerifier
+
+            verifier = DeviceVoteVerifier(val_set, mesh=mesh)
+        self.txflow = TxFlow(
+            chain_id,
+            self._last_block_height,
+            val_set,
+            self.tx_vote_pool,
+            self.mempool,
+            self.commitpool,
+            self.tx_executor,
+            self.tx_store,
+            config=engine_cfg,
+            verifier=verifier,
+            metrics=self.metrics,
+        )
+
+        # -- switch + reactors (node/node.go:688-722; wiring bug fixed) --
+        self.switch = Switch(node_id)
+        mp_bcast = (
+            nc.mempool_broadcast
+            if nc.mempool_broadcast is not None
+            else self.config.mempool.broadcast
+        )
+        vote_bcast = (
+            nc.vote_broadcast
+            if nc.vote_broadcast is not None
+            else self.config.mempool.broadcast
+        )
+        self.mempool_reactor = MempoolReactor(
+            self.mempool,
+            broadcast=mp_bcast,
+            batch_size=nc.gossip_batch,
+        )
+        self.txvote_reactor = TxVoteReactor(
+            self.state_view,
+            self.mempool,
+            self.tx_vote_pool,
+            priv_val=priv_val,
+            broadcast=vote_bcast,
+            batch_size=nc.gossip_batch,
+        )
+        self.switch.add_reactor("mempool", self.mempool_reactor)
+        self.switch.add_reactor("txvote", self.txvote_reactor)
+
+        self._started = False
+
+    # -- state view read by reactors (reference reads state.State) --
+
+    def state_view(self) -> StateView:
+        with self._state_mtx:
+            return StateView(self.chain_id, self._last_block_height, self._val_set)
+
+    def update_state(self, height: int, val_set: ValidatorSet | None = None) -> None:
+        """Block boundary: advance height / rotate validators."""
+        with self._state_mtx:
+            self._last_block_height = height
+            if val_set is not None:
+                self._val_set = val_set
+        self.txflow.update_state(height, val_set or self._val_set)
+        self.txvote_reactor.broadcast_height(height)
+
+    # -- lifecycle (reference OnStart :768-826 / OnStop :829-874) --
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self.switch.start()
+        self.txflow.start()
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        self.txflow.stop()
+        self.switch.stop()
+        self.mempool.close_wal()
+        self.tx_vote_pool.close_wal()
+
+    # -- client surface (RPC broadcast_tx analog until the HTTP layer lands) --
+
+    def broadcast_tx(self, tx: bytes) -> None:
+        """Client tx ingress: local CheckTx; gossip + votes follow."""
+        self.mempool.check_tx(tx)
+
+    def is_committed(self, tx: bytes) -> bool:
+        tx_hash = hashlib.sha256(tx).hexdigest().upper()
+        return self.tx_store.has_tx(tx_hash)
+
+    @property
+    def committed_height_view(self) -> int:
+        with self._state_mtx:
+            return self._last_block_height

@@ -7,11 +7,12 @@ tx slots followed by a threshold compare — one fused XLA reduction, and the
 cross-device combine is a single ``psum`` over the vote-sharding mesh axis.
 
 Voting powers are int64 in the reference. The device tally uses int32 —
-sufficient whenever total voting power < 2^31, which the engine checks at
-epoch build time and otherwise rescales (the quorum decision is invariant
-under proportional scaling only approximately, so instead the engine falls
-back to host-side int64 accumulation for such sets; tendermint itself caps
-total power at 2^63/8, and practical validator sets are far below 2^31).
+with per-batch dedup, per-slot batch stake and prior stake are each at
+most the total power, so their sum stays below 2^31 whenever total power
+is below 2^30. ``DeviceVoteVerifier`` enforces that bound at construction
+and raises, directing such sets to ``ScalarVoteVerifier`` (host int64
+accumulation); tendermint itself caps total power at 2^63/8, and practical
+validator sets are far below 2^30.
 """
 
 from __future__ import annotations
@@ -57,6 +58,21 @@ def verify_and_tally(verify_fn, axis_name: str | None = None):
         return valid, total, total >= quorum
 
     return f
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def compact_step_jit(axis_name: str | None = None):
+    """Process-wide shared jit of ``compact_step``.
+
+    Every ``DeviceVoteVerifier`` in the process (an in-proc validator net
+    runs one per node) must share ONE compiled program per input shape —
+    epoch tables and powers are arguments, so nothing per-verifier is
+    baked in. Constructing a fresh ``jax.jit(compact_step())`` per
+    verifier would compile N times (~tens of seconds each on TPU)."""
+    return jax.jit(compact_step(axis_name))
 
 
 def compact_step(axis_name: str | None = None):

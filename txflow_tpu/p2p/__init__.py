@@ -1,0 +1,49 @@
+"""P2P gossip layer (reference: tendermint p2p Switch/Peer + reactors).
+
+The reference routes amino-framed messages over prioritized byte-channels
+of a TCP MultiplexTransport (node/node.go:420-505); reactors implement
+``p2p.Reactor`` and register channel descriptors (e.g. the txvotepool
+reactor on channel 0x32, txvotepool/reactor.go:25,142-149).
+
+This package keeps those semantics — reactors, channel ids, priorities,
+per-peer send loops with backpressure, sender suppression — over a
+transport interface with two implementations: in-memory duplex pipes for
+in-process validator networks (the reference's MakeConnectedSwitches test
+trick, used here for the BASELINE configs and the gossip tests) and TCP
+sockets for multi-host DCN deployment.
+
+Design deviation, deliberate and TPU-first: where the reference gossips
+one vote per message (txvotepool/reactor.go:236-251), send loops here
+drain *batches* of pool entries into one framed message. The consumer of
+those batches is a device kernel that wants thousands of votes at once;
+per-vote wire messages would bottleneck the host long before the MXU sees
+work.
+"""
+
+from .base import (
+    ChannelDescriptor,
+    Reactor,
+    CHANNEL_MEMPOOL,
+    CHANNEL_TXVOTE,
+    CHANNEL_CONSENSUS_STATE,
+    CHANNEL_CONSENSUS_DATA,
+    CHANNEL_CONSENSUS_VOTE,
+)
+from .switch import Peer, Switch, connect_switches, make_connected_switches
+from .transport import InMemoryConnection, connection_pair
+
+__all__ = [
+    "ChannelDescriptor",
+    "Reactor",
+    "Peer",
+    "Switch",
+    "connect_switches",
+    "make_connected_switches",
+    "InMemoryConnection",
+    "connection_pair",
+    "CHANNEL_MEMPOOL",
+    "CHANNEL_TXVOTE",
+    "CHANNEL_CONSENSUS_STATE",
+    "CHANNEL_CONSENSUS_DATA",
+    "CHANNEL_CONSENSUS_VOTE",
+]

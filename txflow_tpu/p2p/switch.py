@@ -1,0 +1,267 @@
+"""Switch + Peer: reactor registry and per-peer message loops.
+
+Reference semantics kept (p2p.Switch, node/node.go:488-505):
+- reactors register channel descriptors; one reactor owns each channel id;
+- every peer gets a prioritized outbound queue drained by one send thread
+  (the reference's per-peer MConnection send routine) and one recv thread
+  dispatching inbound frames to the owning reactor's ``receive``;
+- a reactor error on receive stops the peer (txvotepool/reactor.go:174);
+- ``make_connected_switches`` wires N switches fully connected over
+  in-memory pipes — the reference's in-process-testnet trick
+  (p2p.MakeConnectedSwitches, txvotepool/reactor_test.go:47-66).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+
+from .base import ChannelDescriptor, Reactor
+from .transport import (
+    ConnectionClosed,
+    TCPConnection,
+    connection_pair,
+    tcp_connect,
+)
+
+_HANDSHAKE_CHANNEL = 0xFF
+
+
+class Peer:
+    """A connected remote switch endpoint."""
+
+    _id_counter = itertools.count(1)
+
+    def __init__(self, conn, node_id: str, outbound: bool, channels: dict[int, ChannelDescriptor]):
+        self.conn = conn
+        self.node_id = node_id
+        self.outbound = outbound
+        self.kv: dict[str, object] = {}  # peer state (reference peer.Set/Get)
+        self._channels = channels
+        self._send_q: queue.PriorityQueue = queue.PriorityQueue(maxsize=4096)
+        self._seq = itertools.count()
+        self._running = threading.Event()
+        self._send_thread: threading.Thread | None = None
+        self._recv_thread: threading.Thread | None = None
+
+    def set(self, key: str, value) -> None:
+        self.kv[key] = value
+
+    def get(self, key: str, default=None):
+        return self.kv.get(key, default)
+
+    def send(self, chan_id: int, msg: bytes, timeout: float | None = 10.0) -> bool:
+        """Queue a message; blocks under backpressure. False if peer down."""
+        if not self._running.is_set():
+            return False
+        prio = -self._channels[chan_id].priority if chan_id in self._channels else 0
+        try:
+            self._send_q.put((prio, next(self._seq), chan_id, msg), timeout=timeout)
+            return True
+        except queue.Full:
+            return False
+
+    def try_send(self, chan_id: int, msg: bytes) -> bool:
+        if not self._running.is_set():
+            return False
+        prio = -self._channels[chan_id].priority if chan_id in self._channels else 0
+        try:
+            self._send_q.put_nowait((prio, next(self._seq), chan_id, msg))
+            return True
+        except queue.Full:
+            return False
+
+    def is_running(self) -> bool:
+        return self._running.is_set()
+
+    def __repr__(self) -> str:
+        return f"Peer({self.node_id}{' out' if self.outbound else ' in'})"
+
+
+class SwitchError(Exception):
+    pass
+
+
+class Switch:
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self.reactors: dict[str, Reactor] = {}
+        self._chan_to_reactor: dict[int, Reactor] = {}
+        self._channels: dict[int, ChannelDescriptor] = {}
+        self._peers: dict[str, Peer] = {}
+        self._mtx = threading.RLock()
+        self._running = False
+
+    # -- reactor registry (reference Switch.AddReactor) --
+
+    def add_reactor(self, name: str, reactor: Reactor) -> Reactor:
+        with self._mtx:
+            for ch in reactor.get_channels():
+                if ch.id in self._chan_to_reactor:
+                    raise SwitchError(f"channel {ch.id:#x} already registered")
+                self._chan_to_reactor[ch.id] = reactor
+                self._channels[ch.id] = ch
+            self.reactors[name] = reactor
+        reactor.set_switch(self)
+        return reactor
+
+    def reactor(self, name: str) -> Reactor | None:
+        return self.reactors.get(name)
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        with self._mtx:
+            if self._running:
+                return
+            self._running = True
+            reactors = list(self.reactors.values())
+        for r in reactors:
+            r.on_start()
+
+    def stop(self) -> None:
+        with self._mtx:
+            if not self._running:
+                return
+            self._running = False
+            peers = list(self._peers.values())
+        for p in peers:
+            self.stop_peer(p, reason="switch stopping")
+        for r in list(self.reactors.values()):
+            r.on_stop()
+
+    @property
+    def is_running(self) -> bool:
+        return self._running
+
+    # -- peers --
+
+    def peers(self) -> list[Peer]:
+        with self._mtx:
+            return list(self._peers.values())
+
+    def n_peers(self) -> int:
+        with self._mtx:
+            return len(self._peers)
+
+    def get_peer(self, node_id: str) -> Peer | None:
+        with self._mtx:
+            return self._peers.get(node_id)
+
+    def add_peer_conn(self, conn, node_id: str, outbound: bool) -> Peer:
+        """Attach a live connection as a peer and start its loops."""
+        peer = Peer(conn, node_id, outbound, dict(self._channels))
+        with self._mtx:
+            if node_id in self._peers:
+                conn.close()
+                raise SwitchError(f"duplicate peer {node_id}")
+            if node_id == self.node_id:
+                conn.close()
+                raise SwitchError("cannot connect to self")
+            self._peers[node_id] = peer
+        peer._running.set()
+        peer._send_thread = threading.Thread(
+            target=self._send_loop, args=(peer,), name=f"p2p-send-{node_id}", daemon=True
+        )
+        peer._recv_thread = threading.Thread(
+            target=self._recv_loop, args=(peer,), name=f"p2p-recv-{node_id}", daemon=True
+        )
+        peer._send_thread.start()
+        peer._recv_thread.start()
+        for r in list(self.reactors.values()):
+            r.add_peer(peer)
+        return peer
+
+    def dial_tcp(self, host: str, port: int) -> Peer:
+        """Outbound TCP connect + node-id handshake."""
+        conn = tcp_connect(host, port)
+        conn.send(_HANDSHAKE_CHANNEL, self.node_id.encode())
+        chan_id, payload = conn.recv(timeout=5.0)
+        if chan_id != _HANDSHAKE_CHANNEL:
+            conn.close()
+            raise SwitchError("handshake expected")
+        return self.add_peer_conn(conn, payload.decode(), outbound=True)
+
+    def accept_tcp(self, sock) -> Peer:
+        """Inbound accept + node-id handshake (call with an accepted socket)."""
+        conn = TCPConnection(sock)
+        chan_id, payload = conn.recv(timeout=5.0)
+        if chan_id != _HANDSHAKE_CHANNEL:
+            conn.close()
+            raise SwitchError("handshake expected")
+        conn.send(_HANDSHAKE_CHANNEL, self.node_id.encode())
+        return self.add_peer_conn(conn, payload.decode(), outbound=False)
+
+    def stop_peer(self, peer: Peer, reason: object = None) -> None:
+        with self._mtx:
+            existing = self._peers.pop(peer.node_id, None)
+        if existing is None:
+            return
+        peer._running.clear()
+        peer.conn.close()
+        for r in list(self.reactors.values()):
+            r.remove_peer(peer, reason)
+
+    def stop_peer_for_error(self, peer: Peer, err: object) -> None:
+        """Reference StopPeerForError: tear down a misbehaving peer."""
+        self.stop_peer(peer, reason=err)
+
+    # -- message plumbing --
+
+    def broadcast(self, chan_id: int, msg: bytes) -> None:
+        for p in self.peers():
+            p.try_send(chan_id, msg)
+
+    def _send_loop(self, peer: Peer) -> None:
+        while peer._running.is_set():
+            try:
+                _, _, chan_id, msg = peer._send_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if not peer.conn.send(chan_id, msg):
+                self.stop_peer(peer, reason="send failed")
+                return
+
+    def _recv_loop(self, peer: Peer) -> None:
+        while peer._running.is_set():
+            try:
+                chan_id, msg = peer.conn.recv()
+            except ConnectionClosed:
+                self.stop_peer(peer, reason="connection closed")
+                return
+            except TimeoutError:
+                continue
+            reactor = self._chan_to_reactor.get(chan_id)
+            if reactor is None:
+                continue  # unknown channel: ignore (switch filters by NodeInfo upstream)
+            try:
+                reactor.receive(chan_id, peer, msg)
+            except Exception as e:  # reference: undecodable msg stops the peer
+                self.stop_peer_for_error(peer, e)
+                return
+
+
+def connect_switches(a: Switch, b: Switch, capacity: int = 1024) -> tuple[Peer, Peer]:
+    """Wire two switches with an in-memory duplex pipe (reference
+    p2p.Connect2Switches)."""
+    ca, cb = connection_pair(capacity, labels=(f"{a.node_id}->{b.node_id}", f"{b.node_id}->{a.node_id}"))
+    pa = a.add_peer_conn(ca, b.node_id, outbound=True)
+    pb = b.add_peer_conn(cb, a.node_id, outbound=False)
+    return pa, pb
+
+
+def make_connected_switches(n: int, init_switch, start: bool = True) -> list[Switch]:
+    """N switches, fully connected (reference p2p.MakeConnectedSwitches).
+
+    ``init_switch(i, switch)`` registers reactors on switch i and returns
+    the switch (mirroring the initSwitch callback upstream).
+    """
+    switches = [init_switch(i, Switch(f"node{i}")) for i in range(n)]
+    if start:
+        for sw in switches:
+            sw.start()
+    for i in range(n):
+        for j in range(i + 1, n):
+            connect_switches(switches[i], switches[j])
+    return switches

@@ -1,0 +1,182 @@
+"""Transports: framed, channel-tagged duplex connections.
+
+Two implementations of one small interface (``send``/``try_send``/``recv``/
+``close``):
+
+- ``InMemoryConnection`` — paired bounded queues, the in-process analog of
+  the reference's MakeConnectedSwitches wiring (txvotepool/reactor_test.go:
+  47-66); used by the BASELINE in-proc validator nets and the gossip tests.
+- ``TCPConnection`` — length-prefixed frames over a socket for multi-host
+  DCN deployment (the reference's MultiplexTransport slot, node/node.go:
+  420-505, minus the station-to-station encryption layer).
+
+Frame format on TCP: ``chan_id u8 | len u32be | payload``.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+
+_FRAME_HDR = struct.Struct("!BI")
+
+# Hard cap on one frame; matches the reference's 1 MiB gossip message cap
+# (consensus/reactor.go:28) with headroom for batched vote frames.
+MAX_FRAME_BYTES = 8 * 1024 * 1024
+
+
+class ConnectionClosed(Exception):
+    pass
+
+
+class InMemoryConnection:
+    """One endpoint of an in-process duplex pipe."""
+
+    def __init__(self, send_q: queue.Queue, recv_q: queue.Queue, label: str = ""):
+        self._send_q = send_q
+        self._recv_q = recv_q
+        self._closed = threading.Event()
+        self.label = label
+
+    def send(self, chan_id: int, msg: bytes, timeout: float | None = 10.0) -> bool:
+        """Blocking send with backpressure; False if closed/timed out."""
+        if self._closed.is_set():
+            return False
+        try:
+            self._send_q.put((chan_id, msg), timeout=timeout)
+            return True
+        except queue.Full:
+            return False
+
+    def try_send(self, chan_id: int, msg: bytes) -> bool:
+        if self._closed.is_set():
+            return False
+        try:
+            self._send_q.put_nowait((chan_id, msg))
+            return True
+        except queue.Full:
+            return False
+
+    def recv(self, timeout: float | None = None) -> tuple[int, bytes]:
+        """Blocks for the next (chan_id, msg); raises ConnectionClosed."""
+        while True:
+            if self._closed.is_set() and self._recv_q.empty():
+                raise ConnectionClosed()
+            try:
+                item = self._recv_q.get(timeout=timeout if timeout else 0.2)
+            except queue.Empty:
+                if timeout is not None:
+                    raise TimeoutError()
+                continue
+            if item is None:  # close sentinel from the other side
+                self._closed.set()
+                raise ConnectionClosed()
+            return item
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._send_q.put_nowait(None)  # wake the remote recv loop
+        except queue.Full:
+            pass
+        try:
+            self._recv_q.put_nowait(None)  # wake our own recv loop
+        except queue.Full:
+            pass
+
+    @property
+    def is_closed(self) -> bool:
+        return self._closed.is_set()
+
+
+def connection_pair(
+    capacity: int = 1024, labels: tuple[str, str] = ("a", "b")
+) -> tuple[InMemoryConnection, InMemoryConnection]:
+    """A duplex in-memory pipe: what a sends, b recvs, and vice versa."""
+    ab: queue.Queue = queue.Queue(maxsize=capacity)
+    ba: queue.Queue = queue.Queue(maxsize=capacity)
+    return (
+        InMemoryConnection(ab, ba, labels[0]),
+        InMemoryConnection(ba, ab, labels[1]),
+    )
+
+
+class TCPConnection:
+    """Framed duplex connection over one TCP socket."""
+
+    def __init__(self, sock: socket.socket, label: str = ""):
+        self._sock = sock
+        self._rfile = sock.makefile("rb")
+        self._wlock = threading.Lock()
+        self._closed = threading.Event()
+        self.label = label
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def send(self, chan_id: int, msg: bytes, timeout: float | None = 10.0) -> bool:
+        if self._closed.is_set():
+            return False
+        if len(msg) > MAX_FRAME_BYTES:
+            raise ValueError(f"frame too large: {len(msg)}")
+        frame = _FRAME_HDR.pack(chan_id, len(msg)) + msg
+        try:
+            with self._wlock:
+                self._sock.sendall(frame)
+            return True
+        except OSError:
+            self.close()
+            return False
+
+    # TCP sends are already buffered by the kernel; try_send == send.
+    try_send = send
+
+    def recv(self, timeout: float | None = None) -> tuple[int, bytes]:
+        if timeout is not None:
+            self._sock.settimeout(timeout)
+        try:
+            hdr = self._rfile.read(_FRAME_HDR.size)
+            if hdr is None or len(hdr) < _FRAME_HDR.size:
+                raise ConnectionClosed()
+            chan_id, length = _FRAME_HDR.unpack(hdr)
+            if length > MAX_FRAME_BYTES:
+                raise ConnectionClosed()
+            payload = self._rfile.read(length)
+            if payload is None or len(payload) < length:
+                raise ConnectionClosed()
+            return chan_id, payload
+        except socket.timeout:
+            raise TimeoutError()
+        except (OSError, ValueError):
+            raise ConnectionClosed()
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    @property
+    def is_closed(self) -> bool:
+        return self._closed.is_set()
+
+
+def tcp_listen(host: str, port: int) -> socket.socket:
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, port))
+    srv.listen(128)
+    return srv
+
+
+def tcp_connect(host: str, port: int, timeout: float = 5.0) -> TCPConnection:
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.settimeout(None)
+    return TCPConnection(sock, label=f"{host}:{port}")

@@ -65,6 +65,18 @@ def sharded_verify_and_tally(mesh: Mesh, axis_name: str = VOTE_AXIS):
     return jax.jit(f)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=8)
+def sharded_compact_step_cached(mesh: Mesh, axis_name: str = VOTE_AXIS):
+    """Process-wide shared jit of the sharded step (Mesh is hashable).
+
+    Shared for the same reason as ``tally.compact_step_jit``: N in-proc
+    nodes over one mesh must reuse one compiled program per shape."""
+    return sharded_compact_step(mesh, axis_name)
+
+
 def sharded_compact_step(mesh: Mesh, axis_name: str = VOTE_AXIS):
     """jit(shard_map) of the compact fused step (ops.tally.compact_step).
 

@@ -20,6 +20,7 @@ from ..crypto.hash import sha256
 from ..utils.cache import LRUCache, NopCache
 from ..utils.config import MempoolConfig
 from ..utils.wal import WAL
+from .base import IngestLogPool
 
 
 class ErrTxInCache(Exception):
@@ -62,7 +63,7 @@ class _MempoolTx:
     senders: set[int] = field(default_factory=set)
 
 
-class Mempool:
+class Mempool(IngestLogPool):
     def __init__(
         self,
         config: MempoolConfig,
@@ -72,13 +73,13 @@ class Mempool:
         post_check=None,
         wal_path: str = "",
     ):
+        super().__init__()  # _mtx/_cond/_seq + compacted ingest log
         self.config = config
         self.proxy_app = proxy_app_conn
         self.height = height
         self.pre_check = pre_check
         self.post_check = post_check
-        self._mtx = threading.RLock()
-        self._txs: dict[bytes, _MempoolTx] = {}  # tx_key -> entry, insertion order
+        self._txs: dict[bytes, _MempoolTx] = self._items  # tx_key -> entry
         self._txs_bytes = 0
         self.cache = LRUCache(config.cache_size) if config.cache_size > 0 else NopCache()
         self._txs_available = threading.Event()
@@ -147,6 +148,7 @@ class Mempool:
                 self.wal.write(tx)
             entry = _MempoolTx(self.height, gas, tx, {tx_info.sender_id})
             self._txs[key] = entry
+            self._log_append(key)
             self._txs_bytes += len(tx)
             self._notify_txs_available()
 
@@ -196,6 +198,14 @@ class Mempool:
             return items[after : after + limit]
         return items[after:]
 
+    def entries_from(
+        self, cursor: int, limit: int = 256
+    ) -> tuple[list[tuple[bytes, bytes, int]], int]:
+        """Stable-cursor walk of live txs: (tx_key, tx, height) triples;
+        see IngestLogPool._entries_from for the cursor contract."""
+        raw, pos = self._entries_from(cursor, limit)
+        return [(k, e.tx, e.height) for k, e in raw], pos
+
     # -- update on commit (reference :358-422) --
 
     def lock(self) -> None:
@@ -234,12 +244,15 @@ class Mempool:
             entry = self._txs.pop(key, None)
             if entry is not None:
                 self._txs_bytes -= len(entry.tx)
+        self._log_compact()
         if len(self._txs) > 0:
             self._notify_txs_available()
 
     def flush(self) -> None:
         with self._mtx:
             self._txs.clear()
+            self._log_base += len(self._log)
+            self._log.clear()
             self._txs_bytes = 0
             self.cache.reset()
 

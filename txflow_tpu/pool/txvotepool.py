@@ -27,6 +27,7 @@ from ..types import TxVote, decode_tx_vote, encode_tx_vote
 from ..utils.cache import LRUCache, NopCache
 from ..utils.config import MempoolConfig
 from ..utils.wal import WAL
+from .base import IngestLogPool
 from .mempool import ErrMempoolIsFull, ErrTxInCache, ErrTxTooLarge, TxInfo
 
 UNKNOWN_PEER_ID = 0
@@ -48,14 +49,12 @@ class _PoolVote:
     senders: set[int] = field(default_factory=set)
 
 
-class TxVotePool:
+class TxVotePool(IngestLogPool):
     def __init__(self, config: MempoolConfig, height: int = 0, wal_path: str = ""):
+        super().__init__()  # _mtx/_cond/_seq + compacted ingest log
         self.config = config
         self.height = height
-        self._mtx = threading.RLock()
-        self._cond = threading.Condition(self._mtx)
-        self._seq = 0  # bumps on every accepted vote (consumer wakeups)
-        self._votes: dict[bytes, _PoolVote] = {}  # vote_key -> entry (ordered)
+        self._votes: dict[bytes, _PoolVote] = self._items  # vote_key -> entry
         self._votes_bytes = 0
         self.cache = LRUCache(config.cache_size) if config.cache_size > 0 else NopCache()
         self._txs_available = threading.Event()
@@ -106,21 +105,6 @@ class TxVotePool:
         self._notify_available = True
         return self._txs_available
 
-    def seq(self) -> int:
-        """Monotonic ingest counter; pairs with wait_for_new."""
-        with self._mtx:
-            return self._seq
-
-    def wait_for_new(self, last_seq: int, timeout: float) -> int:
-        """Block until a vote arrives after last_seq (or timeout); returns
-        the current seq. The engine idles on this instead of spinning —
-        unlike txs_available it fires on EVERY accepted vote, not once per
-        height."""
-        with self._cond:
-            if self._seq == last_seq:
-                self._cond.wait(timeout)
-            return self._seq
-
     def enable_txs_available(self) -> None:
         self._notify_available = True
 
@@ -166,9 +150,8 @@ class TxVotePool:
                 self.wal.write(encoded)
             entry = _PoolVote(self.height, vote, {tx_info.sender_id})
             self._votes[key] = entry
+            self._log_append(key)
             self._votes_bytes += vote_size
-            self._seq += 1
-            self._cond.notify_all()
             self._notify_txs_available()
 
     def _notify_txs_available(self) -> None:
@@ -204,6 +187,14 @@ class TxVotePool:
             return items[after : after + limit]
         return items[after:]
 
+    def entries_from(
+        self, cursor: int, limit: int = 256
+    ) -> tuple[list[tuple[bytes, TxVote, int]], int]:
+        """Stable-cursor walk of live votes: (key, vote, height) triples;
+        see IngestLogPool._entries_from for the cursor contract."""
+        raw, pos = self._entries_from(cursor, limit)
+        return [(k, e.vote, e.height) for k, e in raw], pos
+
     def remove(self, keys: list[bytes], cache_too: bool = False) -> None:
         """Remove votes by key (quorum purge path)."""
         with self._mtx:
@@ -213,6 +204,7 @@ class TxVotePool:
                     self._votes_bytes -= len(encode_tx_vote(entry.vote))
                 if cache_too:
                     self.cache.remove(k)
+            self._log_compact()
 
     # -- update on commit (reference Update :329-359) --
 
@@ -227,11 +219,14 @@ class TxVotePool:
                 entry = self._votes.pop(k, None)
                 if entry is not None:
                     self._votes_bytes -= len(encode_tx_vote(entry.vote))
+            self._log_compact()
             if len(self._votes) > 0:
                 self._notify_txs_available()
 
     def flush(self) -> None:
         with self._mtx:
             self._votes.clear()
+            self._log_base += len(self._log)
+            self._log.clear()
             self._votes_bytes = 0
             self.cache.reset()

@@ -1,0 +1,12 @@
+"""Gossip reactors: mempool tx flood + txvotepool sign/vote flood.
+
+The two pool reactors of the reference — mempool/reactor.go (channel 0x30)
+and txvotepool/reactor.go (channel 0x32, including the ``signTxRoutine``
+that turns every mempool tx into this validator's TxVote) — rebuilt over
+the p2p package with batched frames.
+"""
+
+from .mempool_reactor import MempoolReactor
+from .txvote_reactor import StateView, TxVoteReactor
+
+__all__ = ["MempoolReactor", "TxVoteReactor", "StateView"]

@@ -85,6 +85,44 @@ class ValidatorSet:
         vs = ValidatorSet(self.validators)
         return vs
 
+    def hash(self) -> bytes:
+        """Deterministic digest of (address, pub_key, power) triples, used
+        in block headers (upstream ValidatorSet.Hash)."""
+        from ..crypto.hash import sha256
+
+        acc = bytearray()
+        for v in self.validators:
+            acc += v.address
+            acc += v.pub_key
+            acc += v.voting_power.to_bytes(8, "big", signed=True)
+        return sha256(bytes(acc))
+
+    def update_with_change_set(
+        self, updates: list[tuple[bytes, int]]
+    ) -> "ValidatorSet":
+        """Apply ABCI EndBlock validator updates: (pub_key, power) pairs,
+        power 0 removes (upstream UpdateWithChangeSet semantics, applied at
+        state/execution.go:390-414). Returns a new set; proposer priorities
+        of surviving validators are preserved."""
+        from ..crypto.hash import address_hash
+
+        by_addr = {v.address: v.copy() for v in self.validators}
+        for pub_key, power in updates:
+            addr = address_hash(pub_key)
+            if power < 0:
+                raise ValueError("negative voting power in validator update")
+            if power == 0:
+                if addr not in by_addr:
+                    raise ValueError("removing unknown validator")
+                del by_addr[addr]
+            elif addr in by_addr:
+                by_addr[addr].voting_power = power
+            else:
+                by_addr[addr] = Validator(addr, pub_key, power)
+        if not by_addr:
+            raise ValueError("validator update would empty the set")
+        return ValidatorSet(list(by_addr.values()))
+
     def pub_keys_array(self) -> np.ndarray:
         """(n, 32) uint8 array of compressed pubkeys, validator-index order."""
         if self._pub_keys_np is None:

@@ -91,6 +91,11 @@ class EngineConfig:
     max_slots: int = 4096  # concurrent in-flight txs per step
     use_device: bool = True  # False = scalar golden verifier (debug)
     poll_interval: float = 0.002  # seconds to wait when the pool is empty
+    # batch forming: hold a step for up to batch_wait while fewer than
+    # min_batch votes are pending, so streaming arrivals coalesce into
+    # device-sized batches instead of overhead-dominated tiny kernel calls
+    min_batch: int = 256
+    batch_wait: float = 0.004
 
 
 @dataclass

@@ -152,17 +152,17 @@ class DeviceVoteVerifier:
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            from .parallel.mesh import sharded_compact_step
+            from .parallel.mesh import sharded_compact_step_cached
 
             self._n_shards = mesh.size
-            self._fn = sharded_compact_step(mesh)
+            self._fn = sharded_compact_step_cached(mesh)
             # pre-replicate the per-epoch device constants across the mesh
             rep = NamedSharding(mesh, PartitionSpec())
             self._tables_dev = jax.device_put(self.epoch.tables, rep)
             self._powers_dev = jax.device_put(self._powers, rep)
         else:
             self._n_shards = 1
-            self._fn = jax.jit(tally.compact_step())
+            self._fn = tally.compact_step_jit()
             self._tables_dev = self.epoch.device_tables()
             self._powers_dev = jax.numpy.asarray(self._powers)
 
