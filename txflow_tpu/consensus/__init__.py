@@ -1,0 +1,26 @@
+"""Block-path BFT consensus (reference consensus/ package).
+
+The Tendermint-style round state machine — the "block ticker" fallback
+that orders fast-path commits into replayable blocks (SURVEY §1 layer 6):
+Propose -> Prevote -> Precommit -> Commit with POL locking
+(consensus/state.go:577-1344), a height/round/step-keyed TimeoutTicker
+(consensus/ticker.go:17-24), a consensus WAL with catchup replay
+(consensus/replay.go:48-171), an ABCI Handshaker (replay.go:201-472) and
+a gossip reactor (consensus/reactor.go).
+"""
+
+from .types import RoundState, RoundStep
+from .ticker import TimeoutInfo, TimeoutTicker
+from .state import ConsensusState
+from .reactor import ConsensusReactor
+from .replay import Handshaker
+
+__all__ = [
+    "RoundState",
+    "RoundStep",
+    "TimeoutInfo",
+    "TimeoutTicker",
+    "ConsensusState",
+    "ConsensusReactor",
+    "Handshaker",
+]

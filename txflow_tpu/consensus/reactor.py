@@ -1,0 +1,177 @@
+"""ConsensusReactor: block-path gossip (reference consensus/reactor.go).
+
+Message kinds on the consensus channel (0x20): round-step announcements,
+signed proposals (carrying the full block — no part-sets), block votes,
+and a block-catchup request/response pair that replaces the reference's
+separate blockchain fast-sync reactor v1 for lagging peers.
+
+Deviation (documented): the reference runs per-peer gossip routines that
+walk PeerState bitarrays (reactor.go:465-729); here nodes PUSH their own
+proposals/votes to all peers as they are produced, which is equivalent
+under the full-mesh topologies the framework deploys (validators
+interconnect over DCN; LocalNet mirrors that); catchup for late joiners
+rides the block request/response path.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..p2p.base import CHANNEL_CONSENSUS_STATE, ChannelDescriptor, Reactor
+from ..types.block import Block, decode_block, encode_block
+from ..types.block_vote import decode_block_vote, encode_block_vote
+from ..types.block_vote import BlockVote
+from .state import ConsensusState
+from .types import Proposal, RoundState
+
+MSG_ROUND_STEP = 1
+MSG_PROPOSAL = 2
+MSG_VOTE = 3
+MSG_BLOCK_REQUEST = 4
+MSG_BLOCK_RESPONSE = 5
+
+PEER_HEIGHT_KEY = "consensus_height"
+
+
+def _encode_proposal_msg(p: Proposal, block: Block) -> bytes:
+    return bytes([MSG_PROPOSAL]) + json.dumps(
+        {
+            "height": p.height,
+            "round": p.round,
+            "pol_round": p.pol_round,
+            "block_hash": p.block_hash.hex(),
+            "ts": p.timestamp_ns,
+            "sig": (p.signature or b"").hex(),
+            "block": encode_block(block).hex(),
+        }
+    ).encode()
+
+
+def _decode_proposal_msg(body: bytes) -> tuple[Proposal, Block]:
+    d = json.loads(body)
+    p = Proposal(
+        height=d["height"],
+        round=d["round"],
+        pol_round=d["pol_round"],
+        block_hash=bytes.fromhex(d["block_hash"]),
+        timestamp_ns=d["ts"],
+        signature=bytes.fromhex(d["sig"]) or None,
+    )
+    return p, decode_block(bytes.fromhex(d["block"]))
+
+
+class ConsensusReactor(Reactor):
+    def __init__(self, consensus: ConsensusState):
+        super().__init__("consensus")
+        self.consensus = consensus
+        consensus.broadcast_proposal = self._broadcast_proposal
+        consensus.broadcast_vote = self._broadcast_vote
+        consensus.broadcast_step = self._broadcast_step
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        # priority 5 like the reference state channel (reactor.go:354-377)
+        return [ChannelDescriptor(id=CHANNEL_CONSENSUS_STATE, priority=5)]
+
+    def on_stop(self) -> None:
+        pass
+
+    # -- outbound (hooks called by ConsensusState) --
+
+    def _broadcast_proposal(self, p: Proposal, block: Block) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(
+                CHANNEL_CONSENSUS_STATE, _encode_proposal_msg(p, block)
+            )
+
+    def _broadcast_vote(self, vote: BlockVote) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(
+                CHANNEL_CONSENSUS_STATE,
+                bytes([MSG_VOTE]) + encode_block_vote(vote),
+            )
+
+    def _broadcast_step(self, rs: RoundState) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(CHANNEL_CONSENSUS_STATE, self._step_msg(rs))
+
+    def _step_msg(self, rs: RoundState) -> bytes:
+        return bytes([MSG_ROUND_STEP]) + json.dumps(
+            {
+                "height": rs.height,
+                "round": rs.round,
+                "step": int(rs.step),
+                "committed": self.consensus.state.last_block_height,
+            }
+        ).encode()
+
+    def add_peer(self, peer) -> None:
+        # announce our position so lagging peers can request catchup
+        peer.try_send(CHANNEL_CONSENSUS_STATE, self._step_msg(self.consensus.round_state()))
+
+    # -- inbound --
+
+    def receive(self, chan_id: int, peer, msg: bytes) -> None:
+        if not msg:
+            raise ValueError("empty consensus message")
+        kind, body = msg[0], msg[1:]
+        if kind == MSG_ROUND_STEP:
+            d = json.loads(body)
+            peer.set(PEER_HEIGHT_KEY, d["committed"])
+            my_committed = self.consensus.state.last_block_height
+            if d["committed"] < my_committed:
+                # peer is behind: ship the next block it needs
+                self._send_catchup(peer, d["committed"] + 1)
+            elif d["committed"] > my_committed:
+                # we are behind: ask for our next block
+                peer.try_send(
+                    CHANNEL_CONSENSUS_STATE,
+                    bytes([MSG_BLOCK_REQUEST])
+                    + json.dumps({"height": my_committed + 1}).encode(),
+                )
+        elif kind == MSG_PROPOSAL:
+            p, block = _decode_proposal_msg(body)  # decode error stops peer
+            self.consensus.add_proposal(p, block, peer_id=peer.node_id)
+        elif kind == MSG_VOTE:
+            vote = decode_block_vote(body)
+            self.consensus.add_vote(vote, peer_id=peer.node_id)
+        elif kind == MSG_BLOCK_REQUEST:
+            d = json.loads(body)
+            self._send_catchup(peer, d["height"])
+        elif kind == MSG_BLOCK_RESPONSE:
+            d = json.loads(body)
+            block = decode_block(bytes.fromhex(d["block"]))
+            from ..types.block_vote import decode_block_commit
+
+            commit = decode_block_commit(bytes.fromhex(d["commit"]))
+            self.consensus.apply_catchup_block(block, commit)
+            # keep pulling until caught up
+            peer.try_send(
+                CHANNEL_CONSENSUS_STATE,
+                bytes([MSG_BLOCK_REQUEST])
+                + json.dumps(
+                    {"height": self.consensus.state.last_block_height + 1}
+                ).encode(),
+            )
+        else:
+            raise ValueError(f"unknown consensus msg type {kind}")
+
+    def _send_catchup(self, peer, height: int) -> None:
+        store = self.consensus.block_store
+        if height > store.height():
+            return
+        block = store.load_block(height)
+        commit = store.load_seen_commit(height) or store.load_block_commit(height)
+        if block is None or commit is None:
+            return
+        from ..types.block_vote import encode_block_commit
+
+        peer.try_send(
+            CHANNEL_CONSENSUS_STATE,
+            bytes([MSG_BLOCK_RESPONSE])
+            + json.dumps(
+                {
+                    "block": encode_block(block).hex(),
+                    "commit": encode_block_commit(commit).hex(),
+                }
+            ).encode(),
+        )

@@ -1,0 +1,127 @@
+"""Handshaker: sync the ABCI app with the stores on boot (reference
+consensus/replay.go:201-472).
+
+On restart the app may be behind the block store (crash between block
+save and app commit) or empty (in-memory app). The handshake: query app
+Info for (height, hash); if behind, re-deliver missed blocks.
+
+Fast-path awareness (beyond the reference, whose recovery story for
+per-tx commits is incomplete — SURVEY §0): during replay both ``Txs``
+AND ``Vtxs`` are delivered, because Vtxs' effects entered the app via
+per-tx fast-path commits that a fresh app has not seen; afterwards the
+fast-path commits SINCE the last block are re-applied from the TxStore's
+commit-order log. Normal (non-replay) block application still never
+re-delivers Vtxs.
+"""
+
+from __future__ import annotations
+
+from ..abci.proxy import AppConns
+from ..abci.types import RequestBeginBlock, RequestEndBlock
+from ..state import State, StateStore
+from ..store.block_store import BlockStore
+from ..store.tx_store import TxStore
+from ..types.genesis import GenesisDoc
+
+
+class AppHashMismatch(Exception):
+    pass
+
+
+class Handshaker:
+    def __init__(
+        self,
+        state_store: StateStore,
+        state: State,
+        block_store: BlockStore,
+        genesis: GenesisDoc | None = None,
+        tx_store: TxStore | None = None,
+        mempool=None,
+    ):
+        self.state_store = state_store
+        self.initial_state = state
+        self.block_store = block_store
+        self.genesis = genesis
+        self.tx_store = tx_store
+        self.mempool = mempool
+        self.n_blocks_replayed = 0
+
+    def handshake(self, proxy_app: AppConns) -> State:
+        """Returns the (possibly unchanged) state after syncing the app."""
+        info = proxy_app.query.info_sync()
+        app_height = info.last_block_height
+        state = self.initial_state
+        store_height = self.block_store.height()
+
+        if app_height == 0 and self.genesis is not None:
+            from ..abci.types import ValidatorUpdate
+
+            proxy_app.consensus.init_chain_sync(
+                [
+                    ValidatorUpdate(gv.pub_key, gv.power)
+                    for gv in self.genesis.validators
+                ]
+            )
+
+        # replay store blocks the app has not seen (replay.go:409-498)
+        app_hash = info.last_block_app_hash
+        for h in range(app_height + 1, store_height + 1):
+            block = self.block_store.load_block(h)
+            if block is None:
+                raise ValueError(f"missing block {h} during handshake replay")
+            app_hash = self._exec_replay_block(proxy_app, block)
+            self.n_blocks_replayed += 1
+
+        # re-apply fast-path commits made after the last block's Vtxs were
+        # drained (their effects are in no block yet)
+        if self.tx_store is not None and self.mempool is not None:
+            replayed_from_blocks: set[bytes] = set()
+            for h in range(1, store_height + 1):
+                b = self.block_store.load_block(h)
+                if b is not None:
+                    for tx in list(b.txs) + list(b.vtxs):
+                        import hashlib
+
+                        replayed_from_blocks.add(hashlib.sha256(tx).digest())
+            for tx_hash in self.tx_store.committed_hashes_in_order():
+                key = bytes.fromhex(tx_hash)
+                if key in replayed_from_blocks:
+                    continue
+                tx = self.mempool.get_tx(key)
+                if tx is None:
+                    continue  # tx bytes unavailable (not in mempool WAL)
+                proxy_app.consensus.deliver_tx_async(tx)
+                proxy_app.consensus.flush()
+                res = proxy_app.consensus.commit_sync()
+                app_hash = res.data
+
+        # verify agreement when the app claims a hash (replay.go:258-266)
+        if (
+            app_height == state.last_block_height
+            and info.last_block_app_hash
+            and state.app_hash
+            and info.last_block_app_hash != state.app_hash
+        ):
+            raise AppHashMismatch(
+                f"app hash {info.last_block_app_hash.hex()} != "
+                f"state {state.app_hash.hex()} at height {app_height}"
+            )
+        return state
+
+    def _exec_replay_block(self, proxy_app: AppConns, block) -> bytes:
+        """Deliver one stored block to the app, INCLUDING Vtxs (replay-only
+        behavior — see module docstring), then commit."""
+        conn = proxy_app.consensus
+        conn.begin_block_sync(
+            RequestBeginBlock(
+                hash=block.hash(),
+                height=block.height,
+                proposer_address=block.header.proposer_address,
+            )
+        )
+        for tx in list(block.vtxs) + list(block.txs):
+            conn.deliver_tx_async(tx)
+        conn.flush()
+        conn.end_block_sync(RequestEndBlock(height=block.height))
+        res = conn.commit_sync()
+        return res.data

@@ -1,0 +1,655 @@
+"""ConsensusState: the Tendermint-style round state machine (reference
+consensus/state.go).
+
+Structure preserved from the reference:
+
+- ONE receive routine serializes every input — peer messages, internal
+  (own) messages, timeouts (receiveRoutine :577-647); everything is WAL'd
+  before it mutates state (:620-638);
+- round flow: NewHeight -(timeout_commit)-> NewRound -> Propose (proposer
+  creates the block, reaping mempool txs AND the commitpool's fast-path
+  commits as Vtxs, :945-962) -> Prevote -> PrevoteWait -> Precommit (POL
+  lock/unlock, :1051-1144) -> PrecommitWait -> Commit -> finalize
+  (:1251-1344: save block, WAL EndHeight, ApplyBlock, advance);
+- POL rules (v0.31): prevote the locked block if locked, else the valid
+  proposal; on +2/3 prevotes for a block in this round, lock and
+  precommit it; on +2/3 prevotes for nil, unlock and precommit nil; else
+  precommit nil without unlocking. A newer polka (valid_round) unlocks
+  via the proposal's pol_round path (:968-1020).
+
+Deviations (documented): no part-sets (whole blocks in proposal
+messages), push-style gossip via the reactor instead of per-peer
+walk-routines, single consensus channel. Byzantine-fault handling,
+timeout scheduling, lock rules, and WAL-before-process are semantically
+per the reference.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable
+
+from ..state import BlockExecutor, State
+from ..store.block_store import BlockStore
+from ..types.block import Block
+from ..types.block_vote import (
+    PRECOMMIT,
+    PREVOTE,
+    BlockCommit,
+    BlockVote,
+    HeightVoteSet,
+)
+from ..types.priv_validator import PrivValidator
+from ..utils import failpoints
+from ..utils.config import ConsensusConfig
+from ..utils.events import EventBus, EventNewRoundStep
+from .ticker import TimeoutInfo, TimeoutTicker
+from .types import Proposal, RoundState, RoundStep
+from .wal import ConsensusWAL
+
+
+class ConsensusState:
+    def __init__(
+        self,
+        config: ConsensusConfig,
+        state: State,
+        block_executor: BlockExecutor,
+        block_store: BlockStore,
+        tx_notifier=None,  # object with txs_available() -> Event (mempool)
+        commitpool=None,  # fast-path commits also make blocks non-empty
+        priv_val: PrivValidator | None = None,
+        event_bus: EventBus | None = None,
+        wal_path: str = "",
+        ticker_factory=None,
+        on_commit: Callable[[State], None] | None = None,
+    ):
+        self.config = config
+        self.block_exec = block_executor
+        self.block_store = block_store
+        self.tx_notifier = tx_notifier
+        self.commitpool = commitpool
+        self.priv_val = priv_val
+        self.event_bus = event_bus
+        self.on_commit = on_commit
+        # outbound hooks, set by the reactor: broadcast own proposal/votes
+        self.broadcast_proposal: Callable[[Proposal, Block], None] = lambda p, b: None
+        self.broadcast_vote: Callable[[BlockVote], None] = lambda v: None
+        self.broadcast_step: Callable[[RoundState], None] = lambda rs: None
+
+        self.state = state  # last committed chain state
+        self.rs = RoundState()
+        self._mtx = threading.RLock()
+        self._queue: queue.Queue = queue.Queue(maxsize=10000)
+        self._running = False
+        self._thread: threading.Thread | None = None
+        make_ticker = ticker_factory or TimeoutTicker
+        self.ticker = make_ticker(self._fire_timeout)
+        self.wal = ConsensusWAL(wal_path) if wal_path else None
+        self._decided_once = threading.Event()  # any block committed
+        self.height_committed = threading.Condition()
+
+        self._update_to_state(state)
+
+    # ---------------------------------------------------------------- API
+
+    def start(self) -> None:
+        with self._mtx:
+            if self._running:
+                return
+            self._running = True
+        self.ticker.start()
+        if not self.config.create_empty_blocks:
+            # watcher: wake enterPropose when work shows up in either pool
+            # (reference txNotifier.TxsAvailable into receiveRoutine :590)
+            t = threading.Thread(
+                target=self._txs_watcher, name="consensus-txs", daemon=True
+            )
+            t.start()
+        # catchup replay of the current height's WAL messages (:296-321)
+        if self.wal is not None:
+            for kind, payload in self.wal.messages_after_end_height(
+                self.state.last_block_height
+            ):
+                self._queue.put(("replay_" + kind, payload))
+        self._thread = threading.Thread(
+            target=self._receive_routine, name="consensus", daemon=True
+        )
+        self._thread.start()
+        self._schedule_round0()
+
+    def stop(self) -> None:
+        with self._mtx:
+            if not self._running:
+                return
+            self._running = False
+        self.ticker.stop()
+        self._queue.put(("quit", None))
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self.wal is not None:
+            self.wal.close()
+
+    def add_proposal(self, proposal: Proposal, block: Block, peer_id: str = "") -> None:
+        """Peer/own proposal into the serialized queue."""
+        self._queue.put(("proposal", (proposal, block, peer_id)))
+
+    def add_vote(self, vote: BlockVote, peer_id: str = "") -> None:
+        self._queue.put(("vote", (vote, peer_id)))
+
+    def round_state(self) -> RoundState:
+        with self._mtx:
+            return self.rs
+
+    def is_proposer(self) -> bool:
+        with self._mtx:
+            return (
+                self.priv_val is not None
+                and self.rs.validators is not None
+                and self.rs.validators.get_proposer().address
+                == self.priv_val.get_address()
+            )
+
+    def wait_for_height(self, height: int, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        with self.height_committed:
+            while self.state.last_block_height < height:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self.height_committed.wait(remaining)
+        return True
+
+    # ------------------------------------------------------- receive loop
+
+    def _fire_timeout(self, ti: TimeoutInfo) -> None:
+        self._queue.put(("timeout", ti))
+
+    def _receive_routine(self) -> None:
+        while True:
+            with self._mtx:
+                if not self._running:
+                    return
+            try:
+                kind, payload = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if kind == "quit":
+                return
+            try:
+                self._handle(kind, payload)
+            except Exception:  # a bad peer msg must not kill consensus
+                import traceback
+
+                traceback.print_exc()
+
+    def _handle(self, kind: str, payload) -> None:
+        with self._mtx:
+            if kind == "proposal":
+                proposal, block, _peer = payload
+                if self.wal is not None:
+                    self.wal.write_proposal(proposal, block)
+                self._set_proposal(proposal, block)
+            elif kind == "replay_proposal":
+                proposal, block = payload
+                self._set_proposal(proposal, block)
+            elif kind == "vote":
+                vote, _peer = payload
+                if self.wal is not None:
+                    self.wal.write_vote(vote)
+                self._try_add_vote(vote)
+            elif kind == "replay_vote":
+                self._try_add_vote(payload)
+            elif kind == "timeout":
+                ti: TimeoutInfo = payload
+                rs = self.rs
+                if (
+                    ti.height != rs.height
+                    or ti.round < rs.round
+                    or (ti.round == rs.round and ti.step < int(rs.step))
+                ):
+                    return  # stale (reference handleTimeout :710-717)
+                if self.wal is not None:
+                    self.wal.write_timeout(ti)
+                self._handle_timeout(ti)
+            elif kind == "replay_timeout":
+                ti = payload
+                rs = self.rs
+                if ti.height == rs.height and ti.round >= rs.round:
+                    self._handle_timeout(ti)
+            elif kind == "txs_available":
+                rs = self.rs
+                if rs.step == RoundStep.NEW_ROUND:
+                    self._enter_propose(rs.height, rs.round)
+
+    # -------------------------------------------------------- transitions
+
+    def _update_to_state(self, state: State) -> None:
+        """Reset round state for the next height (reference updateToState
+        :1332-1338 -> :466-560)."""
+        self.state = state
+        height = state.last_block_height + 1
+        # last precommits: the seen commit that finalized the previous block
+        last_commit = None
+        if state.last_block_height > 0:
+            last_commit = self.block_store.load_seen_commit(state.last_block_height)
+        self.rs = RoundState(
+            height=height,
+            round=0,
+            step=RoundStep.NEW_HEIGHT,
+            validators=state.validators.copy(),
+            votes=HeightVoteSet(state.chain_id, height, state.validators),
+            last_commit=last_commit,
+            last_validators=state.last_validators.copy(),
+            start_time_ns=time.time_ns(),
+        )
+        self.rs.votes.set_round(0)
+
+    def _schedule_round0(self) -> None:
+        # NewHeight -> NewRound after timeout_commit (reference :560-576)
+        self.ticker.schedule(
+            TimeoutInfo(
+                0.0 if self.state.last_block_height == 0 or self.config.skip_timeout_commit
+                else self.config.timeout_commit,
+                self.rs.height,
+                0,
+                int(RoundStep.NEW_HEIGHT),
+            )
+        )
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        step = RoundStep(ti.step)
+        if step == RoundStep.NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+        elif step == RoundStep.NEW_ROUND:
+            self._enter_propose(ti.height, 0)
+        elif step == RoundStep.PROPOSE:
+            self._enter_prevote(ti.height, ti.round)
+        elif step == RoundStep.PREVOTE_WAIT:
+            self._enter_precommit(ti.height, ti.round)
+        elif step == RoundStep.PRECOMMIT_WAIT:
+            self._enter_precommit(ti.height, ti.round)
+            self._enter_new_round(ti.height, ti.round + 1)
+
+    def _enter_new_round(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step != RoundStep.NEW_HEIGHT
+        ):
+            return
+        if round_ > rs.round:
+            # proposer rotates per round (reference enterNewRound :780-784)
+            rs.validators = rs.validators.increment_proposer_priority(
+                round_ - rs.round
+            )
+        rs.round = round_
+        rs.step = RoundStep.NEW_ROUND
+        if round_ > 0:
+            # new round: drop the stale proposal (reference :793-799)
+            rs.proposal = None
+            rs.proposal_block = None
+        rs.votes.set_round(round_)
+        self._new_step()
+        # wait for txs before proposing? (create_empty_blocks handling,
+        # reference :809-826)
+        if (
+            not self.config.create_empty_blocks
+            and round_ == 0
+            and self._no_work_pending()
+        ):
+            return  # enterPropose fires on txs_available via _on_txs_available
+        self._enter_propose(height, round_)
+
+    def _no_work_pending(self) -> bool:
+        mempool_empty = (
+            self.block_exec.mempool.size() == 0 if self.block_exec.mempool else True
+        )
+        commitpool_empty = self.commitpool.size() == 0 if self.commitpool else True
+        return mempool_empty and commitpool_empty
+
+    def notify_txs_available(self) -> None:
+        """Mempool/commitpool tx arrival while waiting to propose."""
+        self._queue.put(("txs_available", None))
+
+    def _txs_watcher(self) -> None:
+        last = (-1, -1)
+        while True:
+            with self._mtx:
+                if not self._running:
+                    return
+            cur = (
+                self.tx_notifier.seq() if self.tx_notifier is not None else 0,
+                self.commitpool.seq() if self.commitpool is not None else 0,
+            )
+            if cur != last:
+                last = cur
+                self.notify_txs_available()
+            if self.tx_notifier is not None:
+                self.tx_notifier.wait_for_new(cur[0], timeout=0.05)
+            else:
+                time.sleep(0.05)
+
+    def _enter_propose(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and int(rs.step) >= int(RoundStep.PROPOSE)
+        ):
+            return
+        rs.step = RoundStep.PROPOSE
+        self._new_step()
+        # propose-timeout -> prevote whatever we have (reference :858-861)
+        self.ticker.schedule(
+            TimeoutInfo(
+                self.config.propose_timeout(round_), height, round_,
+                int(RoundStep.PROPOSE),
+            )
+        )
+        if self.is_proposer():
+            self._decide_proposal(height, round_)
+        # if we already have a complete proposal (e.g. replay), advance
+        if rs.proposal_block is not None:
+            self._enter_prevote(height, round_)
+
+    def _decide_proposal(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.locked_block is not None:  # propose the locked block (:889-893)
+            block = rs.locked_block
+            pol_round = rs.locked_round
+        elif rs.valid_block is not None:  # else the last-known polka block
+            block = rs.valid_block
+            pol_round = rs.valid_round
+        else:
+            block = self.block_exec.create_proposal_block(
+                height, self.state, rs.last_commit,
+                self.priv_val.get_address(),
+            )
+            pol_round = -1
+        proposal = Proposal(
+            height=height, round=round_, pol_round=pol_round,
+            block_hash=block.hash(), timestamp_ns=time.time_ns(),
+        )
+        try:
+            self.priv_val.sign_proposal(self.state.chain_id, proposal)
+        except Exception:
+            return  # signer refused (reference logs and returns)
+        # internal message: same serialized path as peer proposals (:912-921)
+        self.add_proposal(proposal, block)
+        self.broadcast_proposal(proposal, block)
+
+    def _set_proposal(self, proposal: Proposal, block: Block | None) -> None:
+        rs = self.rs
+        if rs.proposal is not None:
+            return  # already have one for this round
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        proposer = rs.validators.get_proposer()
+        from ..crypto import ed25519
+
+        if not proposal.signature or not ed25519.verify(
+            proposer.pub_key,
+            proposal.sign_bytes(self.state.chain_id),
+            proposal.signature,
+        ):
+            return  # invalid proposal signature (reference :688-692)
+        if block is None or block.hash() != proposal.block_hash:
+            return
+        rs.proposal = proposal
+        rs.proposal_block = block
+        if int(rs.step) <= int(RoundStep.PROPOSE):
+            self._enter_prevote(rs.height, rs.round)
+        else:
+            self._try_finalize_commit(rs.height)
+
+    def _enter_prevote(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and int(rs.step) >= int(RoundStep.PREVOTE)
+        ):
+            return
+        rs.step = RoundStep.PREVOTE
+        self._new_step()
+        self._do_prevote(height, round_)
+
+    def _do_prevote(self, height: int, round_: int) -> None:
+        """defaultDoPrevote (:968-1020)."""
+        rs = self.rs
+        if rs.locked_block is not None:
+            self._sign_add_vote(PREVOTE, rs.locked_block.hash())
+            return
+        if rs.proposal_block is None:
+            self._sign_add_vote(PREVOTE, b"")  # nil
+            return
+        err = self.block_exec.validate_block(self.state, rs.proposal_block)
+        self._sign_add_vote(PREVOTE, b"" if err else rs.proposal_block.hash())
+
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and int(rs.step) >= int(RoundStep.PREVOTE_WAIT)
+        ):
+            return
+        rs.step = RoundStep.PREVOTE_WAIT
+        self._new_step()
+        self.ticker.schedule(
+            TimeoutInfo(
+                self.config.prevote_timeout(round_), height, round_,
+                int(RoundStep.PREVOTE_WAIT),
+            )
+        )
+
+    def _enter_precommit(self, height: int, round_: int) -> None:
+        """POL lock logic (:1051-1144)."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and int(rs.step) >= int(RoundStep.PRECOMMIT)
+        ):
+            return
+        rs.step = RoundStep.PRECOMMIT
+        self._new_step()
+        maj = rs.votes.prevotes(round_).two_thirds_majority()
+        if maj is None:
+            # no polka: precommit nil, keep any lock (:1072-1086)
+            self._sign_add_vote(PRECOMMIT, b"")
+            return
+        if maj == b"":
+            # polka for nil: unlock (:1096-1105)
+            rs.locked_round = -1
+            rs.locked_block = None
+            self._sign_add_vote(PRECOMMIT, b"")
+            return
+        # polka for a block
+        if rs.locked_block is not None and rs.locked_block.hash() == maj:
+            rs.locked_round = round_  # re-lock at this round (:1110-1116)
+            self._sign_add_vote(PRECOMMIT, maj)
+            return
+        if rs.proposal_block is not None and rs.proposal_block.hash() == maj:
+            err = self.block_exec.validate_block(self.state, rs.proposal_block)
+            if err is None:
+                rs.locked_round = round_
+                rs.locked_block = rs.proposal_block
+                self._sign_add_vote(PRECOMMIT, maj)
+                return
+        # polka for a block we don't have: unlock, precommit nil (:1132-1142)
+        rs.locked_round = -1
+        rs.locked_block = None
+        self._sign_add_vote(PRECOMMIT, b"")
+
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and int(rs.step) >= int(RoundStep.PRECOMMIT_WAIT)
+        ):
+            return
+        rs.step = RoundStep.PRECOMMIT_WAIT
+        self._new_step()
+        self.ticker.schedule(
+            TimeoutInfo(
+                self.config.precommit_timeout(round_), height, round_,
+                int(RoundStep.PRECOMMIT_WAIT),
+            )
+        )
+
+    def _enter_commit(self, height: int, commit_round: int) -> None:
+        rs = self.rs
+        if rs.height != height or int(rs.step) >= int(RoundStep.COMMIT):
+            return
+        rs.step = RoundStep.COMMIT
+        rs.commit_round = commit_round
+        rs.commit_time_ns = time.time_ns()
+        self._new_step()
+        maj = rs.votes.precommits(commit_round).two_thirds_majority()
+        assert maj, "enter_commit without precommit majority"
+        # if the committed block is the locked block, it is the proposal
+        if rs.locked_block is not None and rs.locked_block.hash() == maj:
+            rs.proposal_block = rs.locked_block
+        self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int) -> None:
+        rs = self.rs
+        if rs.height != height or rs.step != RoundStep.COMMIT:
+            return
+        maj = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        if not maj:
+            return
+        if rs.proposal_block is None or rs.proposal_block.hash() != maj:
+            return  # don't have the block yet: wait for gossip/catchup
+        self._finalize_commit(height)
+
+    def _finalize_commit(self, height: int) -> None:
+        """(:1251-1344): save block -> WAL EndHeight -> ApplyBlock -> next."""
+        rs = self.rs
+        block = rs.proposal_block
+        precommits = rs.votes.precommits(rs.commit_round)
+        seen_commit = precommits.make_commit(block.hash())
+
+        if self.block_store.height() < height:
+            self.block_store.save_block(block, seen_commit)
+
+        failpoints.fail("consensus-after-save-block")
+
+        if self.wal is not None:
+            self.wal.write_end_height(height)
+
+        failpoints.fail("consensus-after-end-height")
+
+        new_state = self.block_exec.apply_block(self.state, block)
+
+        self._update_to_state(new_state)
+        self._decided_once.set()
+        if self.on_commit is not None:
+            try:
+                self.on_commit(new_state)
+            except Exception:
+                pass
+        with self.height_committed:
+            self.height_committed.notify_all()
+        self._schedule_round0()
+
+    def apply_catchup_block(self, block: Block, commit: BlockCommit) -> None:
+        """Apply a block received via catchup (the fast-sync analog): the
+        commit must carry +2/3 of the block height's validator set."""
+        from ..state.execution import verify_commit
+
+        with self._mtx:
+            state = self.state
+            if block.height != state.last_block_height + 1:
+                return
+            err = verify_commit(
+                state.chain_id, state.validators, block.hash(), block.height,
+                commit,
+            )
+            if err:
+                return
+            if self.block_store.height() < block.height:
+                self.block_store.save_block(block, commit)
+            if self.wal is not None:
+                self.wal.write_end_height(block.height)
+            new_state = self.block_exec.apply_block(state, block)
+            self._update_to_state(new_state)
+            self._decided_once.set()
+            if self.on_commit is not None:
+                try:
+                    self.on_commit(new_state)
+                except Exception:
+                    pass
+        with self.height_committed:
+            self.height_committed.notify_all()
+        self._schedule_round0()
+
+    # ------------------------------------------------------------- votes
+
+    def _try_add_vote(self, vote: BlockVote) -> None:
+        rs = self.rs
+        if vote.height != rs.height:
+            # late precommit for the previous height extends last_commit
+            return
+        added, err = rs.votes.add_vote(vote)
+        if not added:
+            return
+        if vote.type == PREVOTE:
+            prevotes = rs.votes.prevotes(vote.round)
+            maj = prevotes.two_thirds_majority()
+            if maj is not None and maj != b"":
+                # polka for a block: update valid_* (reference :1522-1534)
+                if rs.valid_round < vote.round and rs.proposal_block is not None \
+                        and rs.proposal_block.hash() == maj:
+                    rs.valid_round = vote.round
+                    rs.valid_block = rs.proposal_block
+                # unlock if locked on something else and a newer polka forms
+                if (
+                    rs.locked_block is not None
+                    and rs.locked_round < vote.round
+                    and rs.locked_block.hash() != maj
+                ):
+                    rs.locked_round = -1
+                    rs.locked_block = None
+            if vote.round == rs.round:
+                if maj is not None:
+                    self._enter_precommit(rs.height, vote.round)
+                elif prevotes.has_two_thirds_any() and int(rs.step) >= int(
+                    RoundStep.PREVOTE
+                ):
+                    self._enter_prevote_wait(rs.height, vote.round)
+            elif vote.round > rs.round and prevotes.has_two_thirds_any():
+                self._enter_new_round(rs.height, vote.round)  # catchup
+        else:  # PRECOMMIT
+            precommits = rs.votes.precommits(vote.round)
+            maj = precommits.two_thirds_majority()
+            if maj is not None and maj != b"":
+                self._enter_commit(rs.height, vote.round)
+            elif maj == b"":
+                # +2/3 precommit nil: straight to next round (:1602-1606)
+                self._enter_new_round(rs.height, vote.round + 1)
+            elif vote.round == rs.round and precommits.has_two_thirds_any():
+                self._enter_precommit_wait(rs.height, vote.round)
+            elif vote.round > rs.round and precommits.has_two_thirds_any():
+                self._enter_new_round(rs.height, vote.round)
+
+    def _sign_add_vote(self, vote_type: int, block_id: bytes) -> None:
+        rs = self.rs
+        if self.priv_val is None or not rs.validators.has_address(
+            self.priv_val.get_address()
+        ):
+            return
+        vote = BlockVote(
+            height=rs.height,
+            round=rs.round,
+            type=vote_type,
+            block_id=block_id,
+            validator_address=self.priv_val.get_address(),
+        )
+        try:
+            self.priv_val.sign_block_vote(self.state.chain_id, vote)
+        except Exception:
+            return
+        self.add_vote(vote)  # own vote through the same serialized path
+        self.broadcast_vote(vote)
+
+    # ------------------------------------------------------------- misc
+
+    def _new_step(self) -> None:
+        if self.event_bus is not None:
+            self.event_bus.publish(EventNewRoundStep, self.rs)
+        self.broadcast_step(self.rs)

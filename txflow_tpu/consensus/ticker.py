@@ -1,0 +1,96 @@
+"""TimeoutTicker (reference consensus/ticker.go:17-24).
+
+One pending timeout at a time, keyed (duration, height, round, step); a
+newer schedule replaces the pending one (timeoutRoutine :94-134 semantics:
+stale timeouts for earlier height/round/step are skipped on fire). Fires
+into the consensus state's message queue via a callback.
+
+A ``ManualTicker`` replaces it in tests for deterministic stepping (the
+reference's mockTicker, consensus/common_test.go:698-741).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable
+
+
+@dataclass(frozen=True)
+class TimeoutInfo:
+    duration: float  # seconds
+    height: int
+    round: int
+    step: int
+
+
+class TimeoutTicker:
+    def __init__(self, fire: Callable[[TimeoutInfo], None]):
+        self._fire = fire
+        self._mtx = threading.Lock()
+        self._timer: threading.Timer | None = None
+        self._pending: TimeoutInfo | None = None
+        self._running = False
+
+    def start(self) -> None:
+        with self._mtx:
+            self._running = True
+
+    def stop(self) -> None:
+        with self._mtx:
+            self._running = False
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            self._pending = None
+
+    def schedule(self, ti: TimeoutInfo) -> None:
+        """Replace any pending timeout with ti."""
+        with self._mtx:
+            if not self._running:
+                return
+            if self._timer is not None:
+                self._timer.cancel()
+            self._pending = ti
+            self._timer = threading.Timer(ti.duration, self._on_fire, args=(ti,))
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _on_fire(self, ti: TimeoutInfo) -> None:
+        with self._mtx:
+            if not self._running or self._pending is not ti:
+                return  # replaced or stopped: stale
+            self._pending = None
+            self._timer = None
+        self._fire(ti)
+
+
+class ManualTicker:
+    """Test ticker: timeouts fire only when the test calls ``fire_next``."""
+
+    def __init__(self, fire: Callable[[TimeoutInfo], None]):
+        self._fire = fire
+        self._mtx = threading.Lock()
+        self._pending: TimeoutInfo | None = None
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def schedule(self, ti: TimeoutInfo) -> None:
+        with self._mtx:
+            self._pending = ti
+
+    def pending(self) -> TimeoutInfo | None:
+        with self._mtx:
+            return self._pending
+
+    def fire_next(self) -> bool:
+        with self._mtx:
+            ti, self._pending = self._pending, None
+        if ti is None:
+            return False
+        self._fire(ti)
+        return True

@@ -1,0 +1,82 @@
+"""RoundState: the consensus-internal state (reference
+consensus/types/round_state.go:20-100) + the 8-step round enum.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from ..types.block import Block
+from ..types.block_vote import BlockCommit, HeightVoteSet
+from ..types.validator import ValidatorSet
+
+
+class RoundStep(enum.IntEnum):
+    NEW_HEIGHT = 1
+    NEW_ROUND = 2
+    PROPOSE = 3
+    PREVOTE = 4
+    PREVOTE_WAIT = 5
+    PRECOMMIT = 6
+    PRECOMMIT_WAIT = 7
+    COMMIT = 8
+
+
+@dataclass
+class Proposal:
+    """A signed block proposal (upstream types.Proposal; the block itself
+    travels in the same message — no part-sets, see p2p package doc)."""
+
+    height: int
+    round: int
+    pol_round: int  # -1 if no proposal-of-lock round
+    block_hash: bytes
+    timestamp_ns: int = 0
+    signature: bytes | None = None
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        from ..codec import amino
+
+        body = bytearray()
+        body += amino.field_key(1, amino.TYP3_8BYTE)
+        body += amino.fixed64(self.height)
+        body += amino.field_key(2, amino.TYP3_8BYTE)
+        body += amino.fixed64(self.round)
+        body += amino.field_key(3, amino.TYP3_VARINT)
+        body += amino.varint(self.pol_round)
+        if self.block_hash:
+            body += amino.field_key(4, amino.TYP3_BYTELEN)
+            body += amino.length_prefixed(self.block_hash)
+        ts = amino.encode_time_body(self.timestamp_ns)
+        if ts:
+            body += amino.field_key(5, amino.TYP3_BYTELEN)
+            body += amino.length_prefixed(ts)
+        if chain_id:
+            body += amino.field_key(6, amino.TYP3_BYTELEN)
+            body += amino.length_prefixed(chain_id.encode())
+        return amino.length_prefixed(bytes(body))
+
+
+@dataclass
+class RoundState:
+    height: int = 1
+    round: int = 0
+    step: RoundStep = RoundStep.NEW_HEIGHT
+    start_time_ns: int = 0
+    commit_time_ns: int = 0
+    validators: ValidatorSet | None = None
+    proposal: Proposal | None = None
+    proposal_block: Block | None = None
+    locked_round: int = -1
+    locked_block: Block | None = None
+    # last known polka (valid_*): most recent +2/3 prevotes for a block
+    valid_round: int = -1
+    valid_block: Block | None = None
+    votes: HeightVoteSet | None = None
+    commit_round: int = -1
+    last_commit: BlockCommit | None = None
+    last_validators: ValidatorSet | None = None
+
+    def round_step_key(self) -> tuple[int, int, int]:
+        return (self.height, self.round, int(self.step))

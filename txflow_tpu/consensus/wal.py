@@ -1,0 +1,126 @@
+"""Consensus WAL: typed message log (reference consensus/wal.go:9-21).
+
+Every message the receive routine processes — proposals (with the full
+block), votes, timeouts — is WAL'd BEFORE it mutates consensus state
+(reference consensus/state.go:620-638), and an EndHeight marker is
+written, fsync'd, after every commit (:1306). Catchup replay re-feeds
+messages after the last EndHeight into the state machine
+(consensus/replay.go:103-171).
+
+Frames ride the shared CRC WAL (utils.wal) with a JSON envelope.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..types.block import Block, decode_block, encode_block
+from ..types.block_vote import BlockVote, decode_block_vote, encode_block_vote
+from ..utils.wal import WAL
+from .ticker import TimeoutInfo
+from .types import Proposal
+
+
+def encode_wal_proposal(p: Proposal, block: Block | None) -> bytes:
+    return json.dumps(
+        {
+            "t": "proposal",
+            "height": p.height,
+            "round": p.round,
+            "pol_round": p.pol_round,
+            "block_hash": p.block_hash.hex(),
+            "ts": p.timestamp_ns,
+            "sig": (p.signature or b"").hex(),
+            "block": encode_block(block).hex() if block is not None else "",
+        }
+    ).encode()
+
+
+def encode_wal_vote(v: BlockVote) -> bytes:
+    return json.dumps({"t": "vote", "v": encode_block_vote(v).hex()}).encode()
+
+
+def encode_wal_timeout(ti: TimeoutInfo) -> bytes:
+    return json.dumps(
+        {
+            "t": "timeout",
+            "duration": ti.duration,
+            "height": ti.height,
+            "round": ti.round,
+            "step": ti.step,
+        }
+    ).encode()
+
+
+def encode_wal_end_height(height: int) -> bytes:
+    return json.dumps({"t": "end_height", "height": height}).encode()
+
+
+def decode_wal_message(raw: bytes):
+    """Returns (kind, payload): ('proposal', (Proposal, Block|None)) |
+    ('vote', BlockVote) | ('timeout', TimeoutInfo) | ('end_height', int)."""
+    d = json.loads(raw)
+    kind = d["t"]
+    if kind == "proposal":
+        p = Proposal(
+            height=d["height"],
+            round=d["round"],
+            pol_round=d["pol_round"],
+            block_hash=bytes.fromhex(d["block_hash"]),
+            timestamp_ns=d["ts"],
+            signature=bytes.fromhex(d["sig"]) or None,
+        )
+        block = decode_block(bytes.fromhex(d["block"])) if d["block"] else None
+        return "proposal", (p, block)
+    if kind == "vote":
+        return "vote", decode_block_vote(bytes.fromhex(d["v"]))
+    if kind == "timeout":
+        return "timeout", TimeoutInfo(
+            d["duration"], d["height"], d["round"], d["step"]
+        )
+    if kind == "end_height":
+        return "end_height", d["height"]
+    raise ValueError(f"unknown WAL message kind {kind!r}")
+
+
+class ConsensusWAL:
+    """Typed wrapper over the CRC-framed WAL file."""
+
+    def __init__(self, path: str):
+        self.wal = WAL(path)
+
+    def write_proposal(self, p: Proposal, block: Block | None) -> None:
+        self.wal.write(encode_wal_proposal(p, block))
+
+    def write_vote(self, v: BlockVote) -> None:
+        self.wal.write(encode_wal_vote(v))
+
+    def write_timeout(self, ti: TimeoutInfo) -> None:
+        self.wal.write(encode_wal_timeout(ti))
+
+    def write_end_height(self, height: int) -> None:
+        # fsync'd: the commit marker is the recovery anchor (:1306)
+        self.wal.write_sync(encode_wal_end_height(height))
+
+    def flush_and_sync(self) -> None:
+        self.wal.flush_and_sync()
+
+    def close(self) -> None:
+        self.wal.close()
+
+    def messages_after_end_height(self, height: int) -> list:
+        """Decoded messages after the LAST 'end_height' marker for
+        ``height`` (or all messages if no such marker) — the catchup
+        replay input (consensus/replay.go:103-171)."""
+        msgs: list = []
+        for raw in self.wal.replay():
+            try:
+                kind, payload = decode_wal_message(raw)
+            except Exception:
+                continue
+            if kind == "end_height":
+                if payload >= height:
+                    msgs = []  # everything before this marker is committed
+                continue
+            msgs.append((kind, payload))
+        return msgs

@@ -38,6 +38,9 @@ class LocalNet:
         gossip_batch: int = 4096,
         sign: bool = True,
         mempool_broadcast: bool | None = None,
+        enable_consensus: bool = False,
+        ticker_factory=None,
+        wal_dir: str = "",
     ):
         self.chain_id = chain_id
         if priv_vals is None:
@@ -68,6 +71,11 @@ class LocalNet:
                     gossip_batch=gossip_batch,
                     use_device_verifier=use_device_verifier,
                     mempool_broadcast=mempool_broadcast,
+                    enable_consensus=enable_consensus,
+                    ticker_factory=ticker_factory,
+                    consensus_wal_path=(
+                        f"{wal_dir}/node{i}-consensus.wal" if wal_dir else ""
+                    ),
                 ),
             )
             self.nodes.append(node)

@@ -19,14 +19,20 @@ from dataclasses import dataclass, field
 
 from ..abci.application import Application
 from ..abci.proxy import AppConns
+from ..consensus.reactor import ConsensusReactor
+from ..consensus.replay import Handshaker
+from ..consensus.state import ConsensusState
 from ..engine.execution import TxExecutor
 from ..engine.txflow import TxFlow
 from ..p2p import Switch
 from ..pool.mempool import Mempool
 from ..pool.txvotepool import TxVotePool
 from ..reactors import MempoolReactor, StateView, TxVoteReactor
+from ..state import BlockExecutor, StateStore, state_from_genesis
+from ..store.block_store import BlockStore
 from ..store.db import MemDB
 from ..store.tx_store import TxStore
+from ..types.genesis import GenesisDoc, GenesisValidator
 from ..types.priv_validator import PrivValidator
 from ..types.validator import ValidatorSet
 from ..utils.config import Config, EngineConfig
@@ -44,6 +50,10 @@ class NodeConfig:
     # per-reactor broadcast toggles (None = follow config.mempool.broadcast)
     mempool_broadcast: bool | None = None
     vote_broadcast: bool | None = None
+    # block-path consensus (the BFT ticker fallback); off = fast path only
+    enable_consensus: bool = True
+    consensus_wal_path: str = ""
+    ticker_factory: object = None
 
 
 class Node:
@@ -56,8 +66,11 @@ class Node:
         priv_val: PrivValidator | None = None,
         node_config: NodeConfig | None = None,
         tx_store_db=None,
+        state_db=None,
+        block_db=None,
         verifier=None,
         mesh=None,
+        genesis: GenesisDoc | None = None,
     ):
         nc = node_config or NodeConfig()
         self.node_id = node_id
@@ -65,10 +78,21 @@ class Node:
         self.config = nc.config
         self.priv_val = priv_val
 
-        # -- replicated-state view (grows into state.State with the block path) --
+        # -- replicated state (reference state.State; node/node.go:570) --
+        if genesis is None:
+            genesis = GenesisDoc(
+                chain_id=chain_id,
+                validators=[
+                    GenesisValidator(v.pub_key, v.voting_power) for v in val_set
+                ],
+            )
+        self.genesis = genesis
+        self.state_store = StateStore(state_db if state_db is not None else MemDB())
+        loaded = self.state_store.load()
+        self.chain_state = loaded if loaded is not None else state_from_genesis(genesis)
         self._state_mtx = threading.Lock()
-        self._last_block_height = 0
-        self._val_set = val_set
+        self._last_block_height = self.chain_state.last_block_height
+        self._val_set = self.chain_state.validators
 
         # -- app + proxy (node/node.go:576) --
         self.app = app
@@ -98,7 +122,7 @@ class Node:
         self.txflow = TxFlow(
             chain_id,
             self._last_block_height,
-            val_set,
+            self._val_set,
             self.tx_vote_pool,
             self.mempool,
             self.commitpool,
@@ -137,6 +161,34 @@ class Node:
         self.switch.add_reactor("mempool", self.mempool_reactor)
         self.switch.add_reactor("txvote", self.txvote_reactor)
 
+        # -- block path: stores + executor + consensus (node/node.go:636-680) --
+        self.block_store = BlockStore(block_db if block_db is not None else MemDB())
+        self.block_executor = BlockExecutor(
+            self.state_store,
+            self.proxy_app.consensus,
+            self.mempool,
+            self.commitpool,
+            event_bus=self.event_bus,
+        )
+        self.consensus: ConsensusState | None = None
+        self.consensus_reactor: ConsensusReactor | None = None
+        if nc.enable_consensus:
+            self.consensus = ConsensusState(
+                self.config.consensus,
+                self.chain_state,
+                self.block_executor,
+                self.block_store,
+                tx_notifier=self.mempool,
+                commitpool=self.commitpool,
+                priv_val=priv_val,
+                event_bus=self.event_bus,
+                wal_path=nc.consensus_wal_path,
+                ticker_factory=nc.ticker_factory,
+                on_commit=self._on_block_commit,
+            )
+            self.consensus_reactor = ConsensusReactor(self.consensus)
+            self.switch.add_reactor("consensus", self.consensus_reactor)
+
         self._started = False
 
     # -- state view read by reactors (reference reads state.State) --
@@ -153,6 +205,14 @@ class Node:
                 self._val_set = val_set
         self.txflow.update_state(height, val_set or self._val_set)
         self.txvote_reactor.broadcast_height(height)
+        self.mempool_reactor.broadcast_height(height)
+
+    def _on_block_commit(self, new_state) -> None:
+        """Consensus commit hook: sync the fast path to the new height and
+        (possibly) rotated validator set (node/node.go's implicit coupling
+        via shared state)."""
+        self.chain_state = new_state
+        self.update_state(new_state.last_block_height, new_state.validators)
 
     # -- lifecycle (reference OnStart :768-826 / OnStop :829-874) --
 
@@ -160,13 +220,26 @@ class Node:
         if self._started:
             return
         self._started = True
+        # handshake-replay the app against the stores (node/node.go:599)
+        Handshaker(
+            self.state_store,
+            self.chain_state,
+            self.block_store,
+            genesis=self.genesis,
+            tx_store=self.tx_store,
+            mempool=self.mempool,
+        ).handshake(self.proxy_app)
         self.switch.start()
         self.txflow.start()
+        if self.consensus is not None:
+            self.consensus.start()
 
     def stop(self) -> None:
         if not self._started:
             return
         self._started = False
+        if self.consensus is not None:
+            self.consensus.stop()
         self.txflow.stop()
         self.switch.stop()
         self.mempool.close_wal()

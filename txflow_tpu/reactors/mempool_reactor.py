@@ -81,6 +81,11 @@ class MempoolReactor(Reactor):
 
     def add_peer(self, peer) -> None:
         self._peer_id(peer)
+        # tell the peer our height so its lag throttle tracks us
+        peer.try_send(
+            CHANNEL_MEMPOOL,
+            bytes([MSG_HEIGHT]) + amino.uvarint(max(self.mempool.height, 0)),
+        )
         if self.broadcast:
             t = threading.Thread(
                 target=self._broadcast_routine,
@@ -90,6 +95,13 @@ class MempoolReactor(Reactor):
             )
             self._threads.append(t)
             t.start()
+
+    def broadcast_height(self, height: int) -> None:
+        """Push a height update to all peers (block-boundary hook)."""
+        if self.switch is not None:
+            self.switch.broadcast(
+                CHANNEL_MEMPOOL, bytes([MSG_HEIGHT]) + amino.uvarint(max(height, 0))
+            )
 
     def receive(self, chan_id: int, peer, msg: bytes) -> None:
         if not msg:

@@ -1,0 +1,20 @@
+"""Replicated state & block execution (reference state/ package).
+
+``State`` is the deterministic chain state snapshot (state/state.go:52-85),
+``BlockExecutor`` creates and applies blocks against the ABCI app —
+including reaping fast-path commits out of the commitpool into ``Vtxs``
+(state/execution.go:88-109) and applying validator-set updates from ABCI
+EndBlock (:390-451).
+"""
+
+from .state import ABCIResponses, State, state_from_genesis
+from .store import StateStore
+from .execution import BlockExecutor
+
+__all__ = [
+    "State",
+    "ABCIResponses",
+    "state_from_genesis",
+    "StateStore",
+    "BlockExecutor",
+]

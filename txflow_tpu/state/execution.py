@@ -1,0 +1,295 @@
+"""BlockExecutor: proposal creation + block application (reference
+state/execution.go).
+
+Pipeline preserved from the reference's ApplyBlock (:124-187):
+validate -> exec on ABCI proxy (BeginBlock / DeliverTx per block.Txs /
+EndBlock) -> save ABCI responses -> validator updates -> updateState ->
+app Commit under the mempool lock -> save state -> fire events. ``Vtxs``
+ride along for replayable ordering but are NEVER re-delivered
+(state/execution.go:293, types/block.go:292-298).
+
+Proposal creation (:88-109) reaps the mempool within byte/gas budgets and
+drains the ENTIRE commitpool into Vtxs — that is how fast-path commits
+re-enter the chain's canonical order.
+
+Defect fixed (vs reference): the reference never purges the commitpool
+after a block commits, so the same Vtxs would be re-proposed forever; here
+``commitpool.update`` removes the included Vtxs on every node.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..abci.proxy import AppConnConsensus
+from ..abci.types import RequestBeginBlock, RequestEndBlock
+from ..pool.mempool import Mempool
+from ..types.block import Block
+from ..types.block_vote import BlockCommit, BlockVoteSet, PRECOMMIT
+from ..types.validator import ValidatorSet
+from ..utils import failpoints
+from ..utils.events import (
+    EventBus,
+    EventDataNewBlock,
+    EventDataTx,
+    EventDataValidatorSetUpdates,
+    EventNewBlock,
+    EventTx,
+    EventValidatorSetUpdates,
+)
+from .state import ABCIResponses, State
+from .store import StateStore
+
+MAX_BLOCK_BYTES = 1024 * 1024  # one-part block cap (framework-native)
+
+
+def verify_commit(
+    chain_id: str, val_set: ValidatorSet, block_id: bytes, height: int,
+    commit: BlockCommit,
+) -> str | None:
+    """2/3+ of val_set must have signed block_id at height (upstream
+    ValidatorSet.VerifyCommit)."""
+    if commit.block_id != block_id:
+        return "commit is for a different block id"
+    total = 0
+    seen: set[bytes] = set()
+    for v in commit.precommits:
+        if v.height != height or v.type != PRECOMMIT:
+            return f"wrong height/type in precommit {v}"
+        if v.block_id != block_id:
+            continue  # nil/other precommits carry no weight
+        if v.validator_address in seen:
+            return "duplicate validator in commit"
+        seen.add(v.validator_address)
+        _, val = val_set.get_by_address(v.validator_address)
+        if val is None:
+            return f"unknown validator {v.validator_address.hex()}"
+        if not v.verify(chain_id, val.pub_key):
+            return f"invalid precommit signature from {v.validator_address.hex()}"
+        total += val.voting_power
+    if total < val_set.quorum_power():
+        return (
+            f"invalid commit: insufficient voting power {total} < "
+            f"{val_set.quorum_power()}"
+        )
+    return None
+
+
+class BlockExecutor:
+    def __init__(
+        self,
+        state_store: StateStore,
+        proxy_app: AppConnConsensus,
+        mempool: Mempool,
+        commitpool: Mempool,
+        event_bus: EventBus | None = None,
+        evidence_pool=None,
+    ):
+        self.state_store = state_store
+        self.proxy_app = proxy_app
+        self.mempool = mempool
+        self.commitpool = commitpool
+        self.event_bus = event_bus
+        self.evidence_pool = evidence_pool
+
+    def set_event_bus(self, bus: EventBus) -> None:
+        self.event_bus = bus
+
+    # -- proposal (reference CreateProposalBlock :88-109) --
+
+    def create_proposal_block(
+        self, height: int, state: State, last_commit: BlockCommit | None,
+        proposer_address: bytes,
+    ) -> Block:
+        txs = self.mempool.reap_max_bytes_max_gas(MAX_BLOCK_BYTES, -1)
+        vtxs = self.commitpool.reap_max_txs(-1)  # ALL fast-path commits
+        return state.make_block(height, txs, vtxs, last_commit, proposer_address)
+
+    # -- validation (reference state/validation.go:18-168) --
+
+    def validate_block(self, state: State, block: Block) -> str | None:
+        err = block.validate_basic()
+        if err:
+            return err
+        h = block.header
+        if h.chain_id != state.chain_id:
+            return f"wrong ChainID: {h.chain_id!r} != {state.chain_id!r}"
+        if h.height != state.last_block_height + 1:
+            return (
+                f"wrong Height: expected {state.last_block_height + 1}, "
+                f"got {h.height}"
+            )
+        if h.last_block_id != state.last_block_id:
+            return "wrong LastBlockID"
+        if h.total_txs != state.last_block_total_tx + len(block.txs):
+            return "wrong TotalTxs"
+        if h.app_hash != state.app_hash:
+            return f"wrong AppHash: {h.app_hash.hex()} != {state.app_hash.hex()}"
+        if h.last_results_hash != state.last_results_hash:
+            return "wrong LastResultsHash"
+        if h.validators_hash != state.validators.hash():
+            return "wrong ValidatorsHash"
+        if h.next_validators_hash != state.next_validators.hash():
+            return "wrong NextValidatorsHash"
+        if not state.validators.has_address(h.proposer_address):
+            return "proposer is not in the validator set"
+        if h.height == 1:
+            if block.last_commit is not None and block.last_commit.precommits:
+                return "block at height 1 can't have LastCommit precommits"
+        else:
+            if block.last_commit is None:
+                return "nil LastCommit"
+            err = verify_commit(
+                state.chain_id, state.last_validators, state.last_block_id,
+                h.height - 1, block.last_commit,
+            )
+            if err:
+                return err
+        return None
+
+    # -- application (reference ApplyBlock :124-187) --
+
+    def apply_block(self, state: State, block: Block) -> State:
+        err = self.validate_block(state, block)
+        if err:
+            raise ValueError(f"invalid block: {err}")
+        block_id = block.hash()
+
+        responses = self._exec_block_on_proxy_app(block)
+
+        failpoints.fail("block-after-exec")
+
+        self.state_store.save_abci_responses(
+            block.height, repr_responses(responses)
+        )
+
+        # validator updates from ABCI EndBlock (:146-157)
+        val_updates = []
+        if responses.end_block is not None:
+            val_updates = [
+                (u.pub_key, u.power) for u in responses.end_block.validator_updates
+            ]
+
+        new_state = update_state(state, block_id, block, responses, val_updates)
+
+        # app Commit under the mempool lock (:195-239)
+        app_hash = self._commit(new_state, block, responses)
+
+        failpoints.fail("block-after-commit")
+
+        new_state.app_hash = app_hash
+        self.state_store.save(new_state)
+
+        failpoints.fail("block-after-save")
+
+        self._fire_events(block, responses, val_updates)
+        return new_state
+
+    def _exec_block_on_proxy_app(self, block: Block) -> ABCIResponses:
+        """BeginBlock / DeliverTx* / EndBlock (:246-310). Vtxs excluded."""
+        self.proxy_app.begin_block_sync(
+            RequestBeginBlock(
+                hash=block.hash(),
+                height=block.height,
+                proposer_address=block.header.proposer_address,
+            )
+        )
+        deliver = []
+        for tx in block.txs:
+            deliver.append(self.proxy_app.deliver_tx_async(tx).value)
+        self.proxy_app.flush()
+        end = self.proxy_app.end_block_sync(RequestEndBlock(height=block.height))
+        return ABCIResponses(deliver_tx=deliver, end_block=end)
+
+    def _commit(self, state: State, block: Block, responses: ABCIResponses) -> bytes:
+        self.mempool.lock()
+        try:
+            self.proxy_app.flush()
+            commit_res = self.proxy_app.commit_sync()
+            self.mempool.update(block.height, block.txs, responses.deliver_tx)
+            # defect fix: purge included Vtxs so they are not re-proposed
+            self.commitpool.lock()
+            try:
+                self.commitpool.update(block.height, block.vtxs)
+            finally:
+                self.commitpool.unlock()
+            return commit_res.data
+        finally:
+            self.mempool.unlock()
+
+    def _fire_events(self, block: Block, responses: ABCIResponses, val_updates) -> None:
+        if self.event_bus is None:
+            return
+        self.event_bus.publish(EventNewBlock, EventDataNewBlock(block=block))
+        import hashlib
+
+        for tx, res in zip(block.txs, responses.deliver_tx):
+            self.event_bus.publish(
+                EventTx,
+                EventDataTx(
+                    height=block.height,
+                    tx=tx,
+                    tx_hash=hashlib.sha256(tx).hexdigest().upper(),
+                    result_code=res.code,
+                    result_data=res.data,
+                    result_log=res.log,
+                ),
+            )
+        if val_updates:
+            self.event_bus.publish(
+                EventValidatorSetUpdates,
+                EventDataValidatorSetUpdates(updates=list(val_updates)),
+            )
+
+
+def update_state(
+    state: State,
+    block_id: bytes,
+    block: Block,
+    responses: ABCIResponses,
+    val_updates: list[tuple[bytes, int]],
+) -> State:
+    """Pure state transition (reference updateState :390-451)."""
+    n_val_set = state.next_validators.copy()
+    last_height_vals_changed = state.last_height_validators_changed
+    if val_updates:
+        n_val_set = n_val_set.update_with_change_set(val_updates)
+        # changes apply at height H+2 (reference :404-407)
+        last_height_vals_changed = block.height + 1 + 1
+    n_val_set = n_val_set.increment_proposer_priority(1)
+    return State(
+        chain_id=state.chain_id,
+        last_block_height=block.height,
+        last_block_total_tx=state.last_block_total_tx + len(block.txs),
+        last_block_id=block_id,
+        last_block_time_ns=block.header.time_ns,
+        validators=state.next_validators.copy(),
+        next_validators=n_val_set,
+        last_validators=state.validators.copy(),
+        last_height_validators_changed=last_height_vals_changed,
+        app_hash=b"",  # filled after app Commit
+        last_results_hash=responses.results_hash(),
+    )
+
+
+def repr_responses(responses: ABCIResponses) -> bytes:
+    """Compact persisted form of the per-block ABCI responses."""
+    import json
+
+    return json.dumps(
+        {
+            "deliver_tx": [
+                {"code": r.code, "data": (r.data or b"").hex(), "log": r.log}
+                for r in responses.deliver_tx
+            ],
+            "validator_updates": [
+                [u.pub_key.hex(), u.power]
+                for u in (
+                    responses.end_block.validator_updates
+                    if responses.end_block is not None
+                    else []
+                )
+            ],
+        },
+        sort_keys=True,
+    ).encode()

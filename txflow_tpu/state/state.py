@@ -1,0 +1,126 @@
+"""State: the deterministic chain-state snapshot (reference state/state.go).
+
+Immutable-by-convention: every block application produces a NEW State via
+``update_state`` (reference state/execution.go:390-451); copies are cheap
+(validator sets are copied, byte fields shared).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field, replace
+
+from ..codec import amino
+from ..crypto.hash import sha256
+from ..types.block import Block, Data, Header, merkle_root
+from ..types.block_vote import BlockCommit
+from ..types.genesis import GenesisDoc
+from ..types.validator import ValidatorSet
+
+
+@dataclass
+class ABCIResponses:
+    """Results of executing one block (reference tsm.ABCIResponses)."""
+
+    deliver_tx: list = field(default_factory=list)  # ResponseDeliverTx per tx
+    end_block: object | None = None  # ResponseEndBlock
+
+    def results_hash(self) -> bytes:
+        leaves = []
+        for r in self.deliver_tx:
+            leaves.append(
+                amino.uvarint(r.code) + amino.length_prefixed(r.data or b"")
+            )
+        return merkle_root(leaves)
+
+
+@dataclass
+class State:
+    chain_id: str = ""
+    last_block_height: int = 0
+    last_block_total_tx: int = 0
+    last_block_id: bytes = b""
+    last_block_time_ns: int = 0
+    # validators: set for the current height; next: for height+1; last: h-1
+    validators: ValidatorSet | None = None
+    next_validators: ValidatorSet | None = None
+    last_validators: ValidatorSet | None = None
+    last_height_validators_changed: int = 0
+    app_hash: bytes = b""
+    last_results_hash: bytes = b""
+
+    def copy(self) -> "State":
+        return replace(
+            self,
+            validators=self.validators.copy() if self.validators else None,
+            next_validators=self.next_validators.copy() if self.next_validators else None,
+            last_validators=self.last_validators.copy() if self.last_validators else None,
+        )
+
+    def is_empty(self) -> bool:
+        return self.validators is None
+
+    def equals(self, other: "State") -> bool:
+        return self.bytes() == other.bytes()
+
+    def bytes(self) -> bytes:
+        """Deterministic digest material for equality/persistence checks."""
+        vh = self.validators.hash() if self.validators else b""
+        nvh = self.next_validators.hash() if self.next_validators else b""
+        lvh = self.last_validators.hash() if self.last_validators else b""
+        return sha256(
+            self.chain_id.encode()
+            + self.last_block_height.to_bytes(8, "big")
+            + self.last_block_total_tx.to_bytes(8, "big")
+            + self.last_block_id
+            + self.last_block_time_ns.to_bytes(8, "big", signed=True)
+            + vh + nvh + lvh
+            + self.last_height_validators_changed.to_bytes(8, "big")
+            + self.app_hash
+            + self.last_results_hash
+        )
+
+    # -- block creation (reference state/state.go:134-164) --
+
+    def make_block(
+        self,
+        height: int,
+        txs: list[bytes],
+        vtxs: list[bytes],
+        last_commit: BlockCommit | None,
+        proposer_address: bytes,
+        time_ns: int | None = None,
+    ) -> Block:
+        header = Header(
+            chain_id=self.chain_id,
+            height=height,
+            time_ns=time_ns if time_ns is not None else _time.time_ns(),
+            num_txs=len(txs),
+            total_txs=self.last_block_total_tx + len(txs),
+            last_block_id=self.last_block_id,
+            validators_hash=self.validators.hash(),
+            next_validators_hash=self.next_validators.hash(),
+            app_hash=self.app_hash,
+            last_results_hash=self.last_results_hash,
+            proposer_address=proposer_address,
+        )
+        block = Block(header=header, data=Data(txs=txs, vtxs=vtxs), last_commit=last_commit)
+        block.fill_header()
+        return block
+
+
+def state_from_genesis(genesis: GenesisDoc) -> State:
+    err = genesis.validate()
+    if err:
+        raise ValueError(f"invalid genesis doc: {err}")
+    val_set = genesis.validator_set()
+    return State(
+        chain_id=genesis.chain_id,
+        last_block_height=0,
+        last_block_time_ns=genesis.genesis_time_ns,
+        validators=val_set.copy(),
+        next_validators=val_set.copy(),
+        last_validators=ValidatorSet([]),  # upstream: empty at genesis
+        last_height_validators_changed=1,
+        app_hash=genesis.app_hash,
+    )

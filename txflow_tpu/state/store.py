@@ -1,0 +1,119 @@
+"""State persistence (reference state/store.go:39-175).
+
+Rows: ``stateKey`` (latest state), per-height validator sets
+(``validatorsKey:H``) and ABCI responses (``abciResponsesKey:H``) so
+handshake replay and evidence lookups can reach historical data.
+Encoding: deterministic JSON of the State fields (framework-native; the
+reference uses amino, but nothing cross-verifies these bytes).
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..store.db import DB
+from ..types.validator import Validator, ValidatorSet
+from .state import State
+
+_STATE_KEY = b"stateKey"
+
+
+def _vals_to_obj(vs: ValidatorSet | None):
+    if vs is None:
+        return None
+    return [
+        {
+            "address": v.address.hex(),
+            "pub_key": v.pub_key.hex(),
+            "power": v.voting_power,
+            "priority": v.proposer_priority,
+        }
+        for v in vs
+    ]
+
+
+def _vals_from_obj(obj) -> ValidatorSet | None:
+    if obj is None:
+        return None
+    return ValidatorSet(
+        [
+            Validator(
+                bytes.fromhex(d["address"]),
+                bytes.fromhex(d["pub_key"]),
+                d["power"],
+                d["priority"],
+            )
+            for d in obj
+        ]
+    )
+
+
+def encode_state(s: State) -> bytes:
+    return json.dumps(
+        {
+            "chain_id": s.chain_id,
+            "last_block_height": s.last_block_height,
+            "last_block_total_tx": s.last_block_total_tx,
+            "last_block_id": s.last_block_id.hex(),
+            "last_block_time_ns": s.last_block_time_ns,
+            "validators": _vals_to_obj(s.validators),
+            "next_validators": _vals_to_obj(s.next_validators),
+            "last_validators": _vals_to_obj(s.last_validators),
+            "last_height_validators_changed": s.last_height_validators_changed,
+            "app_hash": s.app_hash.hex(),
+            "last_results_hash": s.last_results_hash.hex(),
+        },
+        sort_keys=True,
+    ).encode()
+
+
+def decode_state(raw: bytes) -> State:
+    d = json.loads(raw)
+    return State(
+        chain_id=d["chain_id"],
+        last_block_height=d["last_block_height"],
+        last_block_total_tx=d["last_block_total_tx"],
+        last_block_id=bytes.fromhex(d["last_block_id"]),
+        last_block_time_ns=d["last_block_time_ns"],
+        validators=_vals_from_obj(d["validators"]),
+        next_validators=_vals_from_obj(d["next_validators"]),
+        last_validators=_vals_from_obj(d["last_validators"]),
+        last_height_validators_changed=d["last_height_validators_changed"],
+        app_hash=bytes.fromhex(d["app_hash"]),
+        last_results_hash=bytes.fromhex(d["last_results_hash"]),
+    )
+
+
+class StateStore:
+    def __init__(self, db: DB):
+        self.db = db
+
+    def save(self, state: State) -> None:
+        """Persist latest state + the validator set for the NEXT height
+        (reference saveState + saveValidatorsInfo, state/store.go:94-130)."""
+        self.db.set(_STATE_KEY, encode_state(state))
+        if state.next_validators is not None:
+            self.save_validators(state.last_block_height + 2, state.next_validators)
+        if state.last_block_height == 0 and state.validators is not None:
+            # genesis bootstrap: heights 1 and 2
+            self.save_validators(1, state.validators)
+
+    def load(self) -> State | None:
+        raw = self.db.get(_STATE_KEY)
+        return decode_state(raw) if raw is not None else None
+
+    def save_validators(self, height: int, vals: ValidatorSet) -> None:
+        self.db.set(
+            b"validatorsKey:%d" % height,
+            json.dumps(_vals_to_obj(vals), sort_keys=True).encode(),
+        )
+
+    def load_validators(self, height: int) -> ValidatorSet | None:
+        raw = self.db.get(b"validatorsKey:%d" % height)
+        return _vals_from_obj(json.loads(raw)) if raw is not None else None
+
+    def save_abci_responses(self, height: int, payload: bytes) -> None:
+        self.db.set(b"abciResponsesKey:%d" % height, payload)
+
+    def load_abci_responses(self, height: int) -> bytes | None:
+        return self.db.get(b"abciResponsesKey:%d" % height)

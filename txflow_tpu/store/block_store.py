@@ -1,0 +1,67 @@
+"""BlockStore: block persistence (reference store/store.go:29-214).
+
+Rows per height: the block itself (the reference splits meta + parts; our
+transport carries whole blocks, so one row), the block commit (precommits
+that committed it) and the seen-commit (this node's own +2/3 view, which
+may be for a later round); plus a height watermark.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from ..types.block import Block, decode_block, encode_block
+from ..types.block_vote import BlockCommit, decode_block_commit, encode_block_commit
+from .db import DB
+
+_HEIGHT_KEY = b"blockStore"
+
+
+class BlockStore:
+    def __init__(self, db: DB):
+        self.db = db
+        self._mtx = threading.Lock()
+        raw = db.get(_HEIGHT_KEY)
+        self._height = json.loads(raw)["height"] if raw is not None else 0
+
+    def height(self) -> int:
+        with self._mtx:
+            return self._height
+
+    def base(self) -> int:
+        return 1 if self.height() > 0 else 0
+
+    # -- save (reference SaveBlock :146-188) --
+
+    def save_block(self, block: Block, seen_commit: BlockCommit) -> None:
+        height = block.height
+        with self._mtx:
+            if height != self._height + 1:
+                raise ValueError(
+                    f"BlockStore can only save contiguous blocks: wanted "
+                    f"{self._height + 1}, got {height}"
+                )
+            self.db.set(b"B:%d" % height, encode_block(block))
+            if block.last_commit is not None:
+                self.db.set(
+                    b"C:%d" % (height - 1), encode_block_commit(block.last_commit)
+                )
+            self.db.set(b"SC:%d" % height, encode_block_commit(seen_commit))
+            self._height = height
+            self.db.set_sync(_HEIGHT_KEY, json.dumps({"height": height}).encode())
+
+    # -- load (reference LoadBlock/LoadBlockCommit/LoadSeenCommit) --
+
+    def load_block(self, height: int) -> Block | None:
+        raw = self.db.get(b"B:%d" % height)
+        return decode_block(raw) if raw is not None else None
+
+    def load_block_commit(self, height: int) -> BlockCommit | None:
+        """The commit for block `height`, carried in block height+1."""
+        raw = self.db.get(b"C:%d" % height)
+        return decode_block_commit(raw) if raw is not None else None
+
+    def load_seen_commit(self, height: int) -> BlockCommit | None:
+        raw = self.db.get(b"SC:%d" % height)
+        return decode_block_commit(raw) if raw is not None else None

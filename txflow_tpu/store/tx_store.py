@@ -51,12 +51,17 @@ class TxStore:
         self.db = db
         self._mtx = threading.Lock()
         self._height = self._load_height()
+        self._seq = self._load_seq()
 
     def _load_height(self) -> int:
         raw = self.db.get(_HEIGHT_KEY)
         if raw is None:
             return 0
         return json.loads(raw)["height"]
+
+    def _load_seq(self) -> int:
+        raw = self.db.get(b"TxStoreSeq")
+        return json.loads(raw)["seq"] if raw is not None else 0
 
     def height(self) -> int:
         with self._mtx:
@@ -77,6 +82,15 @@ class TxStore:
                     _commit_key(tx_hash),
                     _encode_votes([cs.to_vote() for cs in commit.commits]),
                 )
+            # commit-order log: S:<seq> -> tx_hash, so crash recovery can
+            # replay fast-path commits in the exact order they happened
+            # (the reference stores no order; its recovery story for the
+            # fast path is correspondingly incomplete — SURVEY §0)
+            if not self.db.has(b"O:" + tx_hash.encode()):
+                self.db.set(b"S:%016d" % self._seq, tx_hash.encode())
+                self.db.set(b"O:" + tx_hash.encode(), b"%d" % self._seq)
+                self._seq += 1
+                self.db.set(b"TxStoreSeq", json.dumps({"seq": self._seq}).encode())
             h = vote_set.height()
             if h > self._height:
                 self._height = h
@@ -110,3 +124,10 @@ class TxStore:
 
     def has_tx(self, tx_hash: str) -> bool:
         return self.db.has(_tx_key(tx_hash))
+
+    def committed_hashes_in_order(self) -> list[str]:
+        """Tx hashes in fast-path commit order (crash-recovery replay)."""
+        out = []
+        for _, v in self.db.iterate(b"S:", b"S;"):
+            out.append(v.decode())
+        return out

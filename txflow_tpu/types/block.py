@@ -1,0 +1,260 @@
+"""Block types: Header + Data{Txs, Vtxs} + block-level Commit.
+
+Reference: types/block.go (forked tendermint Block whose ``Data`` carries
+``Vtxs`` — txs already committed via the fast path, riding in blocks for
+replayable time-ordering only; they are NOT re-applied, types/block.go:
+290-302, state/execution.go:293).
+
+Defect fixed (SURVEY §0): the reference's ``Data.Hash()`` merkle-commits
+only ``Txs`` (types/block.go:305-313), leaving Vtxs outside the block
+hash. Here the data hash covers both lists (domain-separated), so the
+fast-path ordering is integrity-protected by the chain.
+
+Encoding: deterministic field encoding built on the amino primitives
+(codec.amino). This is framework-native wire/storage format — the block
+path does not need byte-compatibility with tendermint (the TxVote sign
+bytes, which DO need it, live in tx_vote.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..codec import amino
+from ..crypto.hash import sha256
+from .block_vote import BlockCommit, decode_block_commit, encode_block_commit
+
+MAX_CHAIN_ID_LEN = 50
+
+
+def merkle_root(leaves: list[bytes]) -> bytes:
+    """Tendermint's simple merkle tree over sha256(leaf) hashes.
+
+    RFC-6962 style split (largest power of two < n), empty tree = empty
+    hash — matches upstream merkle.SimpleHashFromByteSlices semantics used
+    by ``Txs.Hash()``."""
+    if not leaves:
+        return sha256(b"")
+    hashes = [sha256(leaf) for leaf in leaves]
+    return _merkle_from_hashes(hashes)
+
+
+def _merkle_from_hashes(hashes: list[bytes]) -> bytes:
+    n = len(hashes)
+    if n == 1:
+        return hashes[0]
+    split = 1
+    while split * 2 < n:
+        split *= 2
+    left = _merkle_from_hashes(hashes[:split])
+    right = _merkle_from_hashes(hashes[split:])
+    return sha256(left + right)
+
+
+@dataclass
+class Data:
+    """Block payload: Txs to apply at height+1, Vtxs already fast-committed."""
+
+    txs: list[bytes] = field(default_factory=list)
+    vtxs: list[bytes] = field(default_factory=list)
+
+    def hash(self) -> bytes:
+        # Defect fix: cover BOTH lists (reference hashes Txs only).
+        # Domain separation so ([a], []) != ([], [a]).
+        return sha256(
+            b"\x00" + merkle_root(self.txs) + b"\x01" + merkle_root(self.vtxs)
+        )
+
+
+@dataclass
+class Header:
+    chain_id: str = ""
+    height: int = 0
+    time_ns: int = 0
+    num_txs: int = 0
+    total_txs: int = 0
+    last_block_id: bytes = b""  # previous block hash ("" at height 1)
+    last_commit_hash: bytes = b""
+    data_hash: bytes = b""
+    validators_hash: bytes = b""
+    next_validators_hash: bytes = b""
+    app_hash: bytes = b""
+    last_results_hash: bytes = b""
+    proposer_address: bytes = b""
+
+    def hash(self) -> bytes:
+        """Block hash = sha256 of the deterministic header encoding."""
+        return sha256(encode_header(self))
+
+
+@dataclass
+class Block:
+    header: Header = field(default_factory=Header)
+    data: Data = field(default_factory=Data)
+    last_commit: BlockCommit | None = None
+
+    @property
+    def height(self) -> int:
+        return self.header.height
+
+    @property
+    def txs(self) -> list[bytes]:
+        return self.data.txs
+
+    @property
+    def vtxs(self) -> list[bytes]:
+        return self.data.vtxs
+
+    def hash(self) -> bytes:
+        return self.header.hash()
+
+    def fill_header(self) -> None:
+        """Populate derived header fields (reference fillHeader)."""
+        if not self.header.data_hash:
+            self.header.data_hash = self.data.hash()
+        if not self.header.last_commit_hash and self.last_commit is not None:
+            self.header.last_commit_hash = self.last_commit.hash()
+
+    def validate_basic(self) -> str | None:
+        """Internal consistency only (reference Block.ValidateBasic)."""
+        if len(self.header.chain_id) > MAX_CHAIN_ID_LEN:
+            return f"ChainID is too long (max {MAX_CHAIN_ID_LEN})"
+        if self.header.height < 0:
+            return "negative Height"
+        if self.header.num_txs != len(self.data.txs):
+            return (
+                f"wrong Header.NumTxs: {self.header.num_txs} != {len(self.data.txs)}"
+            )
+        if self.header.data_hash != self.data.hash():
+            return "wrong Header.DataHash"
+        if self.header.height > 1:
+            if self.last_commit is None:
+                return "nil LastCommit at height > 1"
+            if self.header.last_commit_hash != self.last_commit.hash():
+                return "wrong Header.LastCommitHash"
+        return None
+
+
+def make_block(
+    height: int,
+    txs: list[bytes],
+    vtxs: list[bytes],
+    last_commit: BlockCommit | None,
+) -> Block:
+    """Reference MakeBlock (types/block.go:28-43): header fields that can
+    be computed from the block itself; the rest set by state.make_block."""
+    b = Block(
+        header=Header(height=height, num_txs=len(txs)),
+        data=Data(txs=txs, vtxs=vtxs),
+        last_commit=last_commit,
+    )
+    b.fill_header()
+    return b
+
+
+# ---------------------------------------------------------------------------
+# encoding
+
+
+def encode_header(h: Header) -> bytes:
+    body = bytearray()
+
+    def bfield(num: int, data: bytes | str) -> None:
+        raw = data.encode() if isinstance(data, str) else data
+        if raw:
+            body.extend(amino.field_key(num, amino.TYP3_BYTELEN))
+            body.extend(amino.length_prefixed(raw))
+
+    def vfield(num: int, n: int) -> None:
+        if n:
+            body.extend(amino.field_key(num, amino.TYP3_VARINT))
+            body.extend(amino.varint(n))
+
+    bfield(1, h.chain_id)
+    vfield(2, h.height)
+    vfield(3, h.time_ns)
+    vfield(4, h.num_txs)
+    vfield(5, h.total_txs)
+    bfield(6, h.last_block_id)
+    bfield(7, h.last_commit_hash)
+    bfield(8, h.data_hash)
+    bfield(9, h.validators_hash)
+    bfield(10, h.next_validators_hash)
+    bfield(11, h.app_hash)
+    bfield(12, h.last_results_hash)
+    bfield(13, h.proposer_address)
+    return bytes(body)
+
+
+_HEADER_VARINT_FIELDS = {2: "height", 3: "time_ns", 4: "num_txs", 5: "total_txs"}
+_HEADER_BYTES_FIELDS = {
+    6: "last_block_id",
+    7: "last_commit_hash",
+    8: "data_hash",
+    9: "validators_hash",
+    10: "next_validators_hash",
+    11: "app_hash",
+    12: "last_results_hash",
+    13: "proposer_address",
+}
+
+
+def decode_header(data: bytes) -> Header:
+    r = amino.AminoReader(data)
+    h = Header()
+    while not r.eof():
+        fnum, typ3 = r.read_field_key()
+        if typ3 == amino.TYP3_VARINT and fnum in _HEADER_VARINT_FIELDS:
+            setattr(h, _HEADER_VARINT_FIELDS[fnum], r.read_varint())
+        elif typ3 == amino.TYP3_BYTELEN and fnum == 1:
+            h.chain_id = r.read_bytes().decode()
+        elif typ3 == amino.TYP3_BYTELEN and fnum in _HEADER_BYTES_FIELDS:
+            setattr(h, _HEADER_BYTES_FIELDS[fnum], r.read_bytes())
+        else:
+            r.skip_field(typ3)
+    return h
+
+
+def _encode_tx_list(txs: list[bytes]) -> bytes:
+    out = bytearray()
+    out.extend(amino.uvarint(len(txs)))
+    for tx in txs:
+        out.extend(amino.length_prefixed(tx))
+    return bytes(out)
+
+
+def _decode_tx_list(r: amino.AminoReader) -> list[bytes]:
+    n = r.read_uvarint()
+    return [r.read_bytes() for _ in range(n)]
+
+
+def encode_block(b: Block) -> bytes:
+    body = bytearray()
+    body.extend(amino.field_key(1, amino.TYP3_BYTELEN))
+    body.extend(amino.length_prefixed(encode_header(b.header)))
+    body.extend(amino.field_key(2, amino.TYP3_BYTELEN))
+    body.extend(amino.length_prefixed(_encode_tx_list(b.data.txs)))
+    body.extend(amino.field_key(3, amino.TYP3_BYTELEN))
+    body.extend(amino.length_prefixed(_encode_tx_list(b.data.vtxs)))
+    if b.last_commit is not None:
+        body.extend(amino.field_key(4, amino.TYP3_BYTELEN))
+        body.extend(amino.length_prefixed(encode_block_commit(b.last_commit)))
+    return bytes(body)
+
+
+def decode_block(data: bytes) -> Block:
+    r = amino.AminoReader(data)
+    b = Block()
+    while not r.eof():
+        fnum, typ3 = r.read_field_key()
+        if fnum == 1 and typ3 == amino.TYP3_BYTELEN:
+            b.header = decode_header(r.read_bytes())
+        elif fnum == 2 and typ3 == amino.TYP3_BYTELEN:
+            b.data.txs = _decode_tx_list(amino.AminoReader(r.read_bytes()))
+        elif fnum == 3 and typ3 == amino.TYP3_BYTELEN:
+            b.data.vtxs = _decode_tx_list(amino.AminoReader(r.read_bytes()))
+        elif fnum == 4 and typ3 == amino.TYP3_BYTELEN:
+            b.last_commit = decode_block_commit(r.read_bytes())
+        else:
+            r.skip_field(typ3)
+    return b

@@ -1,0 +1,317 @@
+"""Block-path votes: prevote/precommit, BlockVoteSet, HeightVoteSet, Commit.
+
+Reference: upstream tendermint types.Vote/VoteSet as used by the forked
+consensus (consensus/state.go, consensus/types/height_vote_set.go:35-115).
+Semantics preserved:
+
+- a vote is (height, round, type, block_id) signed by a validator; a nil
+  vote has an empty block_id;
+- VoteSet tallies stake per block_id; 2/3+1 on one block_id is a polka
+  (prevotes) or a commit (precommits); 2/3 of ANY votes unlocks timeouts;
+- one vote per validator per (round, type): identical re-submission is a
+  silent duplicate, a different block_id is rejected as conflicting (the
+  reference detects-then-drops the evidence, types/vote_set.go:123-125);
+- Commit = the precommits that committed a block; carried in the next
+  block and hashed into its header.
+
+Sign bytes use the framework's deterministic amino-primitive encoding
+(chain-id tagged). The TPU batch verifier behind VoteVerifier can verify
+these too — block votes are (msg, sig, validator) triples like TxVotes —
+but block-path volume is tiny (N votes per block, not per tx), so the
+host path is the default.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field, replace
+
+from ..codec import amino
+from ..crypto import ed25519
+from ..crypto.hash import sha256
+from .validator import ValidatorSet
+
+PREVOTE = 1
+PRECOMMIT = 2
+
+_TYPE_NAMES = {PREVOTE: "prevote", PRECOMMIT: "precommit"}
+
+
+def canonical_block_vote_bytes(
+    chain_id: str,
+    height: int,
+    round_: int,
+    vote_type: int,
+    block_id: bytes,
+    timestamp_ns: int,
+) -> bytes:
+    body = bytearray()
+    body += amino.field_key(1, amino.TYP3_8BYTE)
+    body += amino.fixed64(height)
+    body += amino.field_key(2, amino.TYP3_8BYTE)
+    body += amino.fixed64(round_)
+    body += amino.field_key(3, amino.TYP3_VARINT)
+    body += amino.varint(vote_type)
+    if block_id:
+        body += amino.field_key(4, amino.TYP3_BYTELEN)
+        body += amino.length_prefixed(block_id)
+    ts = amino.encode_time_body(timestamp_ns)
+    if ts:
+        body += amino.field_key(5, amino.TYP3_BYTELEN)
+        body += amino.length_prefixed(ts)
+    if chain_id:
+        body += amino.field_key(6, amino.TYP3_BYTELEN)
+        body += amino.length_prefixed(chain_id.encode())
+    return amino.length_prefixed(bytes(body))
+
+
+@dataclass
+class BlockVote:
+    height: int
+    round: int
+    type: int  # PREVOTE | PRECOMMIT
+    block_id: bytes = b""  # empty = nil vote
+    timestamp_ns: int = field(default_factory=_time.time_ns)
+    validator_address: bytes = b""
+    signature: bytes | None = None
+
+    @property
+    def is_nil(self) -> bool:
+        return not self.block_id
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return canonical_block_vote_bytes(
+            chain_id, self.height, self.round, self.type, self.block_id,
+            self.timestamp_ns,
+        )
+
+    def verify(self, chain_id: str, pub_key: bytes) -> bool:
+        return bool(self.signature) and ed25519.verify(
+            pub_key, self.sign_bytes(chain_id), self.signature
+        )
+
+    def copy(self) -> "BlockVote":
+        return replace(self)
+
+    def __repr__(self) -> str:
+        bid = self.block_id.hex()[:12] if self.block_id else "nil"
+        return (
+            f"BlockVote({_TYPE_NAMES.get(self.type)} h={self.height} "
+            f"r={self.round} {bid} val={self.validator_address.hex()[:8]})"
+        )
+
+
+def encode_block_vote(v: BlockVote) -> bytes:
+    body = bytearray()
+    body += amino.field_key(1, amino.TYP3_VARINT)
+    body += amino.varint(v.height)
+    body += amino.field_key(2, amino.TYP3_VARINT)
+    body += amino.varint(v.round)
+    body += amino.field_key(3, amino.TYP3_VARINT)
+    body += amino.varint(v.type)
+    if v.block_id:
+        body += amino.field_key(4, amino.TYP3_BYTELEN)
+        body += amino.length_prefixed(v.block_id)
+    ts = amino.encode_time_body(v.timestamp_ns)
+    if ts:
+        body += amino.field_key(5, amino.TYP3_BYTELEN)
+        body += amino.length_prefixed(ts)
+    if v.validator_address:
+        body += amino.field_key(6, amino.TYP3_BYTELEN)
+        body += amino.length_prefixed(v.validator_address)
+    if v.signature:
+        body += amino.field_key(7, amino.TYP3_BYTELEN)
+        body += amino.length_prefixed(v.signature)
+    return bytes(body)
+
+
+def decode_block_vote(data: bytes) -> BlockVote:
+    r = amino.AminoReader(data)
+    v = BlockVote(height=0, round=0, type=0, timestamp_ns=0)
+    while not r.eof():
+        fnum, typ3 = r.read_field_key()
+        if typ3 == amino.TYP3_VARINT:
+            val = r.read_varint()
+            if fnum == 1:
+                v.height = val
+            elif fnum == 2:
+                v.round = val
+            elif fnum == 3:
+                v.type = val
+            else:
+                pass
+        elif typ3 == amino.TYP3_BYTELEN:
+            raw = r.read_bytes()
+            if fnum == 4:
+                v.block_id = raw
+            elif fnum == 5:
+                v.timestamp_ns = amino.decode_time_body(raw)
+            elif fnum == 6:
+                v.validator_address = raw
+            elif fnum == 7:
+                v.signature = raw
+        else:
+            r.skip_field(typ3)
+    return v
+
+
+@dataclass
+class BlockCommit:
+    """The precommits that committed a block (upstream types.Commit)."""
+
+    block_id: bytes = b""
+    precommits: list[BlockVote] = field(default_factory=list)
+
+    def height(self) -> int:
+        return self.precommits[0].height if self.precommits else 0
+
+    def round(self) -> int:
+        return self.precommits[0].round if self.precommits else 0
+
+    def hash(self) -> bytes:
+        from .block import merkle_root  # cycle-free at call time
+
+        return merkle_root([encode_block_vote(v) for v in self.precommits])
+
+
+def encode_block_commit(c: BlockCommit) -> bytes:
+    body = bytearray()
+    if c.block_id:
+        body += amino.field_key(1, amino.TYP3_BYTELEN)
+        body += amino.length_prefixed(c.block_id)
+    for v in c.precommits:
+        body += amino.field_key(2, amino.TYP3_BYTELEN)
+        body += amino.length_prefixed(encode_block_vote(v))
+    return bytes(body)
+
+
+def decode_block_commit(data: bytes) -> BlockCommit:
+    r = amino.AminoReader(data)
+    c = BlockCommit()
+    while not r.eof():
+        fnum, typ3 = r.read_field_key()
+        if fnum == 1 and typ3 == amino.TYP3_BYTELEN:
+            c.block_id = r.read_bytes()
+        elif fnum == 2 and typ3 == amino.TYP3_BYTELEN:
+            c.precommits.append(decode_block_vote(r.read_bytes()))
+        else:
+            r.skip_field(typ3)
+    return c
+
+
+class ErrConflictingBlockVote(Exception):
+    pass
+
+
+class BlockVoteSet:
+    """Stake tally for one (height, round, type) (upstream types.VoteSet)."""
+
+    def __init__(
+        self, chain_id: str, height: int, round_: int, vote_type: int,
+        val_set: ValidatorSet,
+    ):
+        self.chain_id = chain_id
+        self.height = height
+        self.round = round_
+        self.type = vote_type
+        self.val_set = val_set
+        self.votes: dict[bytes, BlockVote] = {}  # validator address -> vote
+        self._by_block: dict[bytes, int] = {}  # block_id -> stake
+        self._sum = 0
+        self._maj23_block: bytes | None = None
+
+    def add_vote(self, vote: BlockVote) -> tuple[bool, Exception | None]:
+        if vote.height != self.height or vote.round != self.round or vote.type != self.type:
+            return False, ValueError(
+                f"vote for wrong (h,r,t): {vote} vs "
+                f"({self.height},{self.round},{self.type})"
+            )
+        _, val = self.val_set.get_by_address(vote.validator_address)
+        if val is None:
+            return False, ValueError("unknown validator")
+        existing = self.votes.get(vote.validator_address)
+        if existing is not None:
+            if existing.block_id == vote.block_id and existing.signature == vote.signature:
+                return False, None  # duplicate
+            return False, ErrConflictingBlockVote(f"{existing} vs {vote}")
+        if not vote.verify(self.chain_id, val.pub_key):
+            return False, ValueError("invalid signature")
+        self.votes[vote.validator_address] = vote
+        self._sum += val.voting_power
+        stake = self._by_block.get(vote.block_id, 0) + val.voting_power
+        self._by_block[vote.block_id] = stake
+        if self._maj23_block is None and stake >= self.val_set.quorum_power():
+            self._maj23_block = vote.block_id
+        return True, None
+
+    def two_thirds_majority(self) -> bytes | None:
+        """block_id with 2/3+1 stake (b"" = nil decision), or None."""
+        return self._maj23_block
+
+    def has_two_thirds_majority(self) -> bool:
+        return self._maj23_block is not None
+
+    def has_two_thirds_any(self) -> bool:
+        return self._sum >= self.val_set.quorum_power()
+
+    def get_by_address(self, address: bytes) -> BlockVote | None:
+        return self.votes.get(address)
+
+    def vote_list(self) -> list[BlockVote]:
+        return list(self.votes.values())
+
+    def size(self) -> int:
+        return len(self.votes)
+
+    def make_commit(self, block_id: bytes) -> BlockCommit:
+        assert self._maj23_block == block_id and block_id
+        return BlockCommit(
+            block_id,
+            [v.copy() for v in self.votes.values() if v.block_id == block_id],
+        )
+
+
+class HeightVoteSet:
+    """All rounds' prevotes + precommits for one height (reference
+    consensus/types/height_vote_set.go:35-115)."""
+
+    def __init__(self, chain_id: str, height: int, val_set: ValidatorSet):
+        self.chain_id = chain_id
+        self.height = height
+        self.val_set = val_set
+        self._sets: dict[tuple[int, int], BlockVoteSet] = {}
+        self.round = 0
+
+    def set_round(self, round_: int) -> None:
+        """Pre-create sets up to round_ (+1 for catchup, like upstream)."""
+        self.round = round_
+        for r in range(round_ + 2):
+            self._get(r, PREVOTE)
+            self._get(r, PRECOMMIT)
+
+    def _get(self, round_: int, vote_type: int) -> BlockVoteSet:
+        key = (round_, vote_type)
+        vs = self._sets.get(key)
+        if vs is None:
+            vs = BlockVoteSet(self.chain_id, self.height, round_, vote_type, self.val_set)
+            self._sets[key] = vs
+        return vs
+
+    def prevotes(self, round_: int) -> BlockVoteSet:
+        return self._get(round_, PREVOTE)
+
+    def precommits(self, round_: int) -> BlockVoteSet:
+        return self._get(round_, PRECOMMIT)
+
+    def add_vote(self, vote: BlockVote) -> tuple[bool, Exception | None]:
+        if vote.type not in (PREVOTE, PRECOMMIT):
+            return False, ValueError(f"bad vote type {vote.type}")
+        return self._get(vote.round, vote.type).add_vote(vote)
+
+    def pol_info(self) -> tuple[int, bytes | None]:
+        """Highest round with a prevote polka: (round, block_id) or (-1, None)."""
+        for r in sorted({k[0] for k in self._sets}, reverse=True):
+            maj = self.prevotes(r).two_thirds_majority()
+            if maj is not None:
+                return r, maj
+        return -1, None

@@ -1,0 +1,85 @@
+"""GenesisDoc (upstream types.GenesisDoc, consumed at node/node.go:1161-1201).
+
+JSON on disk; provides the initial validator set and chain id from which
+``state.State`` is derived when the state DB is empty.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from .validator import Validator, ValidatorSet
+
+
+@dataclass
+class GenesisValidator:
+    pub_key: bytes  # ed25519, 32 bytes
+    power: int
+    name: str = ""
+
+
+@dataclass
+class GenesisDoc:
+    chain_id: str
+    validators: list[GenesisValidator] = field(default_factory=list)
+    genesis_time_ns: int = 0
+    app_hash: bytes = b""
+    app_state: dict = field(default_factory=dict)
+
+    def validator_set(self) -> ValidatorSet:
+        return ValidatorSet(
+            [Validator.from_pub_key(gv.pub_key, gv.power) for gv in self.validators]
+        )
+
+    def validate(self) -> str | None:
+        if not self.chain_id:
+            return "genesis doc must include non-empty chain_id"
+        if not self.validators:
+            return "genesis doc must include at least one validator"
+        for gv in self.validators:
+            if gv.power <= 0:
+                return f"validator {gv.name!r} has non-positive power"
+            if len(gv.pub_key) != 32:
+                return f"validator {gv.name!r} pub key must be 32 bytes"
+        return None
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "chain_id": self.chain_id,
+                "genesis_time_ns": self.genesis_time_ns,
+                "app_hash": self.app_hash.hex(),
+                "validators": [
+                    {"pub_key": gv.pub_key.hex(), "power": gv.power, "name": gv.name}
+                    for gv in self.validators
+                ],
+                "app_state": self.app_state,
+            },
+            indent=2,
+        )
+
+    @classmethod
+    def from_json(cls, raw: str) -> "GenesisDoc":
+        d = json.loads(raw)
+        return cls(
+            chain_id=d["chain_id"],
+            genesis_time_ns=d.get("genesis_time_ns", 0),
+            app_hash=bytes.fromhex(d.get("app_hash", "")),
+            validators=[
+                GenesisValidator(
+                    bytes.fromhex(v["pub_key"]), v["power"], v.get("name", "")
+                )
+                for v in d.get("validators", [])
+            ],
+            app_state=d.get("app_state", {}),
+        )
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "GenesisDoc":
+        with open(path) as f:
+            return cls.from_json(f.read())

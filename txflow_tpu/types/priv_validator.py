@@ -22,6 +22,10 @@ class PrivValidator(Protocol):
 
     def sign_tx_vote(self, chain_id: str, vote: TxVote) -> None: ...
 
+    def sign_block_vote(self, chain_id: str, vote) -> None: ...
+
+    def sign_proposal(self, chain_id: str, proposal) -> None: ...
+
 
 class MockPV:
     """In-memory signer without safety or persistence — tests only."""
@@ -51,6 +55,20 @@ class MockPV:
         )
         vote.signature = ed25519.sign(self._seed, vote.sign_bytes(use_chain_id))
 
+    def sign_block_vote(self, chain_id: str, vote) -> None:
+        """Sign a block-path prevote/precommit (reference SignVote)."""
+        use_chain_id = "incorrect-chain-id" if self.break_vote_signing else chain_id
+        vote.signature = ed25519.sign(self._seed, vote.sign_bytes(use_chain_id))
+
+    def sign_proposal(self, chain_id: str, proposal) -> None:
+        """Sign a block proposal (reference SignProposal)."""
+        use_chain_id = (
+            "incorrect-chain-id" if self.break_proposal_signing else chain_id
+        )
+        proposal.signature = ed25519.sign(
+            self._seed, proposal.sign_bytes(use_chain_id)
+        )
+
     def sign_bytes_raw(self, data: bytes) -> bytes:
         return ed25519.sign(self._seed, data)
 
@@ -70,4 +88,10 @@ class ErroringMockPV(MockPV):
     """Fails every signing request (reference :124-148) — tests only."""
 
     def sign_tx_vote(self, chain_id: str, vote: TxVote) -> None:
+        raise ErroringMockPVError("erroringMockPV always returns an error")
+
+    def sign_block_vote(self, chain_id: str, vote) -> None:
+        raise ErroringMockPVError("erroringMockPV always returns an error")
+
+    def sign_proposal(self, chain_id: str, proposal) -> None:
         raise ErroringMockPVError("erroringMockPV always returns an error")
