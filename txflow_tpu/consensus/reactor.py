@@ -69,8 +69,14 @@ class ConsensusReactor(Reactor):
         consensus.broadcast_step = self._broadcast_step
 
     def get_channels(self) -> list[ChannelDescriptor]:
-        # priority 5 like the reference state channel (reactor.go:354-377)
-        return [ChannelDescriptor(id=CHANNEL_CONSENSUS_STATE, priority=5)]
+        # priority 6 (above the bulk txvote/mempool channels) and reliable:
+        # proposals/votes are push-once, so a queue-pressure drop would
+        # stall the round until timeout (reference gives consensus its own
+        # high-priority channels + per-peer retransmit walks, reactor.go:
+        # 354-377; this framework's equivalent is the lossless lane)
+        return [
+            ChannelDescriptor(id=CHANNEL_CONSENSUS_STATE, priority=6, reliable=True)
+        ]
 
     def on_stop(self) -> None:
         pass

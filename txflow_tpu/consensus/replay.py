@@ -47,7 +47,18 @@ class Handshaker:
         self.n_blocks_replayed = 0
 
     def handshake(self, proxy_app: AppConns) -> State:
-        """Returns the (possibly unchanged) state after syncing the app."""
+        """Returns the state after syncing the app AND the state store.
+
+        Crash windows handled (reference replay.go:294-464):
+        - app behind store (crash before app commit): replay the missing
+          blocks into the app, recording each post-commit app hash;
+        - state behind store (crash between block save / app commit and
+          the state save — 'consensus-after-*' / 'block-after-commit'
+          failpoints): advance state through the extra block(s) from the
+          saved ABCI responses, WITHOUT re-delivering txs the app already
+          committed, so state, store, and app agree before consensus
+          starts and block H is never executed twice.
+        """
         info = proxy_app.query.info_sync()
         app_height = info.last_block_height
         state = self.initial_state
@@ -65,12 +76,67 @@ class Handshaker:
 
         # replay store blocks the app has not seen (replay.go:409-498)
         app_hash = info.last_block_app_hash
+        replay_hashes: dict[int, bytes] = {}  # height -> post-commit app hash
+        replay_responses: dict[int, object] = {}  # height -> ABCIResponses
         for h in range(app_height + 1, store_height + 1):
             block = self.block_store.load_block(h)
             if block is None:
                 raise ValueError(f"missing block {h} during handshake replay")
-            app_hash = self._exec_replay_block(proxy_app, block)
+            app_hash, responses = self._exec_replay_block(proxy_app, block)
+            replay_hashes[h] = app_hash
+            replay_responses[h] = responses
             self.n_blocks_replayed += 1
+
+        # advance the state store through blocks it missed (storeHeight >
+        # stateHeight window, replay.go:294-340): reconstruct each state
+        # transition from the saved ABCI responses (written before the app
+        # commit) or, if those are gone too, from a live replay response.
+        if store_height > state.last_block_height:
+            from ..state.execution import parse_responses, update_state
+            from ..state.state import ABCIResponses
+
+            for h in range(state.last_block_height + 1, store_height + 1):
+                block = self.block_store.load_block(h)
+                if block is None:
+                    raise ValueError(f"missing block {h} during state catchup")
+                # response source, best first: persisted at exec time; the
+                # live responses this handshake's own replay just computed
+                # (crash at 'block-after-exec': block saved, responses not);
+                # empty only if neither exists
+                raw = self.state_store.load_abci_responses(h)
+                if raw is not None:
+                    responses = parse_responses(raw)
+                elif h in replay_responses:
+                    responses = replay_responses[h]
+                else:
+                    responses = ABCIResponses()
+                val_updates = (
+                    [
+                        (u.pub_key, u.power)
+                        for u in responses.end_block.validator_updates
+                    ]
+                    if responses.end_block is not None
+                    else []
+                )
+                new_state = update_state(
+                    state, block.hash(), block, responses, val_updates
+                )
+                # exact post-commit app hash for this height, best source
+                # first: persisted at commit time; recorded during this
+                # handshake's own replay; the next block's header (which
+                # carries the previous height's app hash); current app hash.
+                saved_hash = self.state_store.load_app_hash(h)
+                if saved_hash is not None:
+                    new_state.app_hash = saved_hash
+                elif h in replay_hashes:
+                    new_state.app_hash = replay_hashes[h]
+                else:
+                    nxt = self.block_store.load_block(h + 1)
+                    new_state.app_hash = (
+                        nxt.header.app_hash if nxt is not None else app_hash
+                    )
+                self.state_store.save(new_state)
+                state = new_state
 
         # re-apply fast-path commits made after the last block's Vtxs were
         # drained (their effects are in no block yet)
@@ -108,9 +174,14 @@ class Handshaker:
             )
         return state
 
-    def _exec_replay_block(self, proxy_app: AppConns, block) -> bytes:
+    def _exec_replay_block(self, proxy_app: AppConns, block):
         """Deliver one stored block to the app, INCLUDING Vtxs (replay-only
-        behavior — see module docstring), then commit."""
+        behavior — see module docstring), then commit. Returns
+        (app_hash, ABCIResponses) where the responses cover block.txs only
+        (matching what the normal exec path records: Vtxs are never part of
+        the results hash)."""
+        from ..state.state import ABCIResponses
+
         conn = proxy_app.consensus
         conn.begin_block_sync(
             RequestBeginBlock(
@@ -119,9 +190,12 @@ class Handshaker:
                 proposer_address=block.header.proposer_address,
             )
         )
+        results = []
         for tx in list(block.vtxs) + list(block.txs):
-            conn.deliver_tx_async(tx)
+            results.append(conn.deliver_tx_async(tx).value)
         conn.flush()
-        conn.end_block_sync(RequestEndBlock(height=block.height))
+        end = conn.end_block_sync(RequestEndBlock(height=block.height))
         res = conn.commit_sync()
-        return res.data
+        return res.data, ABCIResponses(
+            deliver_tx=results[len(block.vtxs):], end_block=end
+        )

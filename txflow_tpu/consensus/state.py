@@ -89,6 +89,17 @@ class ConsensusState:
         self.wal = ConsensusWAL(wal_path) if wal_path else None
         self._decided_once = threading.Event()  # any block committed
         self.height_committed = threading.Condition()
+        # votes for height+1 that arrived while we finalize this height:
+        # without buffering, push-once gossip loses them permanently and
+        # slow nodes fall onto block catchup every height (ADVICE r2).
+        # Keyed by (validator, type, round) first-wins so a byzantine peer
+        # cannot evict honest votes with duplicates; validator membership
+        # is checked against next_validators at buffering time.
+        self._future_votes: dict[tuple, tuple[BlockVote, str]] = {}
+        # votes to re-feed after the current message finishes (drained by
+        # the receive routine — a blocking _queue.put here would deadlock:
+        # this thread is the queue's only consumer)
+        self._reinject: list[tuple[BlockVote, str]] = []
 
         self._update_to_state(state)
 
@@ -152,6 +163,13 @@ class ConsensusState:
                 == self.priv_val.get_address()
             )
 
+    def reset_to_state(self, state: State) -> None:
+        """Adopt a handshake-advanced state BEFORE start() (node boot found
+        the state store behind the block store and caught it up)."""
+        with self._mtx:
+            assert not self._running, "reset_to_state after start"
+            self._update_to_state(state)
+
     def wait_for_height(self, height: int, timeout: float = 30.0) -> bool:
         deadline = time.monotonic() + timeout
         with self.height_committed:
@@ -184,6 +202,16 @@ class ConsensusState:
                 import traceback
 
                 traceback.print_exc()
+            # buffered future votes released by a height change: processed
+            # here at top level, exactly like fresh arrivals
+            while self._reinject:
+                vote, peer = self._reinject.pop(0)
+                try:
+                    self._handle("vote", (vote, peer))
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
 
     def _handle(self, kind: str, payload) -> None:
         with self._mtx:
@@ -196,10 +224,10 @@ class ConsensusState:
                 proposal, block = payload
                 self._set_proposal(proposal, block)
             elif kind == "vote":
-                vote, _peer = payload
+                vote, peer = payload
                 if self.wal is not None:
                     self.wal.write_vote(vote)
-                self._try_add_vote(vote)
+                self._try_add_vote(vote, peer)
             elif kind == "replay_vote":
                 self._try_add_vote(payload)
             elif kind == "timeout":
@@ -246,6 +274,19 @@ class ConsensusState:
             start_time_ns=time.time_ns(),
         )
         self.rs.votes.set_round(0)
+        # re-feed buffered votes that were early for the previous height and
+        # are now current; handed to the receive routine via _reinject (NOT
+        # _queue.put: this runs on the receive thread itself, and blocking
+        # on the full queue it alone drains would deadlock consensus)
+        if self._future_votes:
+            self._reinject.extend(
+                vp for vp in self._future_votes.values() if vp[0].height == height
+            )
+            self._future_votes = {
+                k: vp
+                for k, vp in self._future_votes.items()
+                if vp[0].height > height
+            }
 
     def _schedule_round0(self) -> None:
         # NewHeight -> NewRound after timeout_commit (reference :560-576)
@@ -580,12 +621,22 @@ class ConsensusState:
 
     # ------------------------------------------------------------- votes
 
-    def _try_add_vote(self, vote: BlockVote) -> None:
+    def _try_add_vote(self, vote: BlockVote, peer_id: str = "") -> None:
         rs = self.rs
         if vote.height != rs.height:
-            # late precommit for the previous height extends last_commit
+            if vote.height == rs.height + 1 and len(self._future_votes) < 4096:
+                # buffer next-height votes arriving while we finalize this
+                # height; released by _update_to_state. Only votes from
+                # validators of the next height's set are kept, first-wins
+                # per (validator, type, round)
+                nv = self.state.next_validators
+                if nv is not None and nv.has_address(vote.validator_address):
+                    key = (vote.validator_address, vote.type, vote.round)
+                    self._future_votes.setdefault(key, (vote, peer_id))
+            elif vote.height == rs.height - 1 and vote.type == PRECOMMIT:
+                self._extend_last_commit(vote)
             return
-        added, err = rs.votes.add_vote(vote)
+        added, err = rs.votes.add_vote(vote, peer_id)
         if not added:
             return
         if vote.type == PREVOTE:
@@ -626,6 +677,24 @@ class ConsensusState:
                 self._enter_precommit_wait(rs.height, vote.round)
             elif vote.round > rs.round and precommits.has_two_thirds_any():
                 self._enter_new_round(rs.height, vote.round)
+
+    def _extend_last_commit(self, vote: BlockVote) -> None:
+        """Fold a late precommit for the committed previous height into the
+        stored seen-commit (commit-gossip liveness: the reference extends
+        cs.LastCommit so lagging peers can still assemble +2/3)."""
+        rs = self.rs
+        commit = rs.last_commit
+        if commit is None or vote.block_id != commit.block_id:
+            return
+        if any(
+            v.validator_address == vote.validator_address for v in commit.precommits
+        ):
+            return
+        _, val = rs.last_validators.get_by_address(vote.validator_address)
+        if val is None or not vote.verify(self.state.chain_id, val.pub_key):
+            return
+        commit.precommits.append(vote)
+        self.block_store.save_seen_commit(vote.height, commit)
 
     def _sign_add_vote(self, vote_type: int, block_id: bytes) -> None:
         rs = self.rs

@@ -296,7 +296,14 @@ class TxFlow:
         """Block boundary: new height / possibly rotated validator set."""
         with self._mtx:
             self.height = height
-            if val_set is not self.val_set:
+            # content comparison, not identity: every block commit hands in
+            # a fresh ValidatorSet copy (execution.update_state copies
+            # next_validators), and rebuilding DeviceVoteVerifier — pubkey
+            # decompression + device_put of epoch tables — once per block
+            # would stall the hot vote path for an unchanged set
+            if val_set is not self.val_set and (
+                val_set.hash() != self.val_set.hash()
+            ):
                 # Build the new verifier BEFORE swapping any engine state so
                 # a constructor failure cannot leave val_set/_addr_to_idx
                 # pointing at the new epoch while the verifier still gathers

@@ -220,8 +220,11 @@ class Node:
         if self._started:
             return
         self._started = True
-        # handshake-replay the app against the stores (node/node.go:599)
-        Handshaker(
+        # handshake-replay the app against the stores (node/node.go:599);
+        # the handshake may advance state past the snapshot loaded in
+        # __init__ (crash between block save and state save) — every
+        # component keyed on height/validators must adopt the result
+        new_state = Handshaker(
             self.state_store,
             self.chain_state,
             self.block_store,
@@ -229,6 +232,16 @@ class Node:
             tx_store=self.tx_store,
             mempool=self.mempool,
         ).handshake(self.proxy_app)
+        if new_state.last_block_height != self.chain_state.last_block_height:
+            self.chain_state = new_state
+            with self._state_mtx:
+                self._last_block_height = new_state.last_block_height
+                self._val_set = new_state.validators
+            self.txflow.update_state(
+                new_state.last_block_height, new_state.validators
+            )
+            if self.consensus is not None:
+                self.consensus.reset_to_state(new_state)
         self.switch.start()
         self.txflow.start()
         if self.consensus is not None:

@@ -24,6 +24,11 @@ class ChannelDescriptor:
     priority: int = 1
     send_queue_capacity: int = 64
     recv_message_capacity: int = 1024 * 1024  # 1 MiB (consensus/reactor.go:28)
+    # reliable channels are never dropped on queue pressure and are drained
+    # ahead of the shared priority queue: consensus proposals/votes are
+    # push-once (no retransmit), so one drop stalls the whole round until
+    # timeout (ADVICE r2) — unlike txvote/mempool batches, which re-gossip
+    reliable: bool = False
 
 
 class Reactor:

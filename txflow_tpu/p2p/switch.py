@@ -26,6 +26,7 @@ from .transport import (
 )
 
 _HANDSHAKE_CHANNEL = 0xFF
+_WAKE_CHANNEL = 0xFE  # internal sentinel: wakes a send loop, never sent
 
 
 class Peer:
@@ -40,6 +41,12 @@ class Peer:
         self.kv: dict[str, object] = {}  # peer state (reference peer.Set/Get)
         self._channels = channels
         self._send_q: queue.PriorityQueue = queue.PriorityQueue(maxsize=4096)
+        # lane for reliable channels (consensus): never dropped under BULK
+        # pressure (its pressure is its own), drained ahead of the shared
+        # queue. Bounded all the same — a stalled peer must not grow memory
+        # without limit; at this depth the peer is effectively dead and
+        # will resync via block catchup when it returns
+        self._reliable_q: queue.Queue = queue.Queue(maxsize=1024)
         self._seq = itertools.count()
         self._running = threading.Event()
         self._send_thread: threading.Thread | None = None
@@ -51,10 +58,28 @@ class Peer:
     def get(self, key: str, default=None):
         return self.kv.get(key, default)
 
+    def _is_reliable(self, chan_id: int) -> bool:
+        ch = self._channels.get(chan_id)
+        return ch is not None and ch.reliable
+
+    def _put_reliable(self, chan_id: int, msg: bytes) -> bool:
+        try:
+            self._reliable_q.put_nowait((chan_id, msg))
+        except queue.Full:
+            return False  # peer stalled beyond any live-round backlog
+        # wake the send loop if it is blocked on the shared queue
+        try:
+            self._send_q.put_nowait((-(1 << 30), next(self._seq), _WAKE_CHANNEL, b""))
+        except queue.Full:
+            pass  # loop is busy draining anyway
+        return True
+
     def send(self, chan_id: int, msg: bytes, timeout: float | None = 10.0) -> bool:
         """Queue a message; blocks under backpressure. False if peer down."""
         if not self._running.is_set():
             return False
+        if self._is_reliable(chan_id):
+            return self._put_reliable(chan_id, msg)
         prio = -self._channels[chan_id].priority if chan_id in self._channels else 0
         try:
             self._send_q.put((prio, next(self._seq), chan_id, msg), timeout=timeout)
@@ -65,6 +90,8 @@ class Peer:
     def try_send(self, chan_id: int, msg: bytes) -> bool:
         if not self._running.is_set():
             return False
+        if self._is_reliable(chan_id):
+            return self._put_reliable(chan_id, msg)
         prio = -self._channels[chan_id].priority if chan_id in self._channels else 0
         try:
             self._send_q.put_nowait((prio, next(self._seq), chan_id, msg))
@@ -215,10 +242,17 @@ class Switch:
 
     def _send_loop(self, peer: Peer) -> None:
         while peer._running.is_set():
+            # reliable lane first: consensus messages must not wait behind
+            # (or be dropped by) bulk txvote/mempool batches
             try:
-                _, _, chan_id, msg = peer._send_q.get(timeout=0.2)
+                chan_id, msg = peer._reliable_q.get_nowait()
             except queue.Empty:
-                continue
+                try:
+                    _, _, chan_id, msg = peer._send_q.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                if chan_id == _WAKE_CHANNEL:
+                    continue
             if not peer.conn.send(chan_id, msg):
                 self.stop_peer(peer, reason="send failed")
                 return

@@ -134,6 +134,7 @@ class TCPConnection:
     try_send = send
 
     def recv(self, timeout: float | None = None) -> tuple[int, bytes]:
+        prev_timeout = self._sock.gettimeout()
         if timeout is not None:
             self._sock.settimeout(timeout)
         try:
@@ -148,9 +149,20 @@ class TCPConnection:
                 raise ConnectionClosed()
             return chan_id, payload
         except socket.timeout:
-            raise TimeoutError()
+            # a timeout mid-frame leaves the buffered reader desynced
+            # (partially consumed frame) — that is a connection error, not
+            # a retryable idle timeout; only a clean pre-header timeout
+            # (nothing buffered, nothing read) is retryable
+            self.close()
+            raise ConnectionClosed()
         except (OSError, ValueError):
             raise ConnectionClosed()
+        finally:
+            if timeout is not None and not self._closed.is_set():
+                try:
+                    self._sock.settimeout(prev_timeout)
+                except OSError:
+                    pass
 
     def close(self) -> None:
         self._closed.set()

@@ -174,6 +174,7 @@ class BlockExecutor:
 
         # app Commit under the mempool lock (:195-239)
         app_hash = self._commit(new_state, block, responses)
+        self.state_store.save_app_hash(block.height, app_hash)
 
         failpoints.fail("block-after-commit")
 
@@ -270,6 +271,30 @@ def update_state(
         app_hash=b"",  # filled after app Commit
         last_results_hash=responses.results_hash(),
     )
+
+
+def parse_responses(payload: bytes) -> ABCIResponses:
+    """Inverse of ``repr_responses``: rebuild the per-block ABCI responses
+    saved before the app commit, for handshake state reconstruction
+    (reference LoadABCIResponses, state/store.go:134-156)."""
+    import json
+
+    from ..abci.types import ResponseDeliverTx, ResponseEndBlock, ValidatorUpdate
+
+    d = json.loads(payload)
+    deliver = [
+        ResponseDeliverTx(
+            code=r["code"], data=bytes.fromhex(r["data"]), log=r["log"]
+        )
+        for r in d["deliver_tx"]
+    ]
+    end = ResponseEndBlock(
+        validator_updates=[
+            ValidatorUpdate(bytes.fromhex(pk), power)
+            for pk, power in d["validator_updates"]
+        ]
+    )
+    return ABCIResponses(deliver_tx=deliver, end_block=end)
 
 
 def repr_responses(responses: ABCIResponses) -> bytes:

@@ -65,3 +65,8 @@ class BlockStore:
     def load_seen_commit(self, height: int) -> BlockCommit | None:
         raw = self.db.get(b"SC:%d" % height)
         return decode_block_commit(raw) if raw is not None else None
+
+    def save_seen_commit(self, height: int, commit: BlockCommit) -> None:
+        """Re-save an extended seen-commit (late precommits folded in for
+        commit-gossip liveness, reference consensus/state.go:583-601)."""
+        self.db.set(b"SC:%d" % height, encode_block_commit(commit))

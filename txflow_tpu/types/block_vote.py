@@ -281,6 +281,12 @@ class HeightVoteSet:
         self.val_set = val_set
         self._sets: dict[tuple[int, int], BlockVoteSet] = {}
         self.round = 0
+        # a peer may introduce at most 2 rounds beyond round+1 (its declared
+        # catchup rounds) — without this bound a byzantine peer could make
+        # us allocate unbounded vote sets by naming arbitrary rounds
+        # (reference height_vote_set.go:35-115, the very bound the r2
+        # review flagged as missing)
+        self._peer_catchup_rounds: dict[str, list[int]] = {}
 
     def set_round(self, round_: int) -> None:
         """Pre-create sets up to round_ (+1 for catchup, like upstream)."""
@@ -303,9 +309,21 @@ class HeightVoteSet:
     def precommits(self, round_: int) -> BlockVoteSet:
         return self._get(round_, PRECOMMIT)
 
-    def add_vote(self, vote: BlockVote) -> tuple[bool, Exception | None]:
+    def add_vote(
+        self, vote: BlockVote, peer_id: str = ""
+    ) -> tuple[bool, Exception | None]:
         if vote.type not in (PREVOTE, PRECOMMIT):
             return False, ValueError(f"bad vote type {vote.type}")
+        if vote.round > self.round + 1 and peer_id:
+            # beyond the rounds we track: admit only a peer's declared
+            # catchup rounds, max 2 per peer (height_vote_set.go:84-102)
+            rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
+            if vote.round not in rounds:
+                if len(rounds) >= 2:
+                    return False, ValueError(
+                        f"unwanted round {vote.round} from peer {peer_id}"
+                    )
+                rounds.append(vote.round)
         return self._get(vote.round, vote.type).add_vote(vote)
 
     def pol_info(self) -> tuple[int, bytes | None]:
