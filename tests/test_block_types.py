@@ -1,0 +1,286 @@
+"""Block-path type tests: Block encode/hash/validate, BlockVoteSet /
+HeightVoteSet quorum semantics, BlockStore persistence.
+
+Mirrors the reference's types/block_test.go, consensus/types tests and
+store/store_test.go scopes (SURVEY §4 contract tests 1-2 for the block
+path); quorum/conflict cases follow types/vote_set_test.go:84-276.
+"""
+
+import conftest  # noqa: F401  (forces the CPU mesh before jax loads)
+
+import hashlib
+
+import pytest
+
+from txflow_tpu.state import state_from_genesis
+from txflow_tpu.store.block_store import BlockStore
+from txflow_tpu.store.db import MemDB
+from txflow_tpu.types.block import Block, Data, decode_block, encode_block
+from txflow_tpu.types.block_vote import (
+    PRECOMMIT,
+    PREVOTE,
+    BlockVote,
+    BlockVoteSet,
+    ErrConflictingBlockVote,
+    HeightVoteSet,
+    decode_block_commit,
+    decode_block_vote,
+    encode_block_commit,
+    encode_block_vote,
+)
+from txflow_tpu.types.genesis import GenesisDoc, GenesisValidator
+from txflow_tpu.types.priv_validator import MockPV
+from txflow_tpu.types.validator import Validator, ValidatorSet
+
+CHAIN_ID = "test-block-types"
+
+
+def make_valset(n=4, power=10):
+    pvs = [MockPV(hashlib.sha256(b"btv-%d" % i).digest()) for i in range(n)]
+    vs = ValidatorSet([Validator.from_pub_key(pv.get_pub_key(), power) for pv in pvs])
+    by_addr = {pv.get_address(): pv for pv in pvs}
+    sorted_pvs = [by_addr[v.address] for v in vs]
+    return vs, sorted_pvs
+
+
+def make_state(vs):
+    gen = GenesisDoc(
+        chain_id=CHAIN_ID,
+        validators=[GenesisValidator(v.pub_key, v.voting_power) for v in vs],
+    )
+    return state_from_genesis(gen)
+
+
+def make_test_block(state, txs=(b"a=1", b"b=2"), vtxs=(b"c=3",), height=1):
+    proposer = state.validators.get_proposer().address
+    return state.make_block(height, list(txs), list(vtxs), None, proposer)
+
+
+def signed_block_vote(pv, height, round_, vtype, block_id, chain_id=CHAIN_ID):
+    v = BlockVote(
+        height=height,
+        round=round_,
+        type=vtype,
+        block_id=block_id,
+        validator_address=pv.get_address(),
+    )
+    pv.sign_block_vote(chain_id, v)
+    return v
+
+
+# ---------------------------------------------------------------- Block
+
+
+def test_block_encode_decode_roundtrip():
+    vs, _ = make_valset()
+    state = make_state(vs)
+    b = make_test_block(state)
+    raw = encode_block(b)
+    b2 = decode_block(raw)
+    assert b2.hash() == b.hash()
+    assert b2.txs == b.txs and b2.vtxs == b.vtxs
+    assert b2.header.chain_id == CHAIN_ID
+    assert b2.validate_basic() is None
+
+
+def test_block_hash_covers_vtxs():
+    """The reference's Data.Hash omits Vtxs (types/block.go:305-313 defect,
+    SURVEY §0); the rebuild merkle-commits them."""
+    d1 = Data(txs=[b"a"], vtxs=[b"v1"])
+    d2 = Data(txs=[b"a"], vtxs=[b"v2"])
+    assert d1.hash() != d2.hash()
+    vs, _ = make_valset()
+    state = make_state(vs)
+    b1 = make_test_block(state, vtxs=(b"v1",))
+    b2 = make_test_block(state, vtxs=(b"v2",))
+    b2.header.time_ns = b1.header.time_ns
+    b2.fill_header()
+    assert b1.hash() != b2.hash()
+
+
+def test_block_validate_basic_rejects_tampering():
+    vs, _ = make_valset()
+    state = make_state(vs)
+    b = make_test_block(state)
+    assert b.validate_basic() is None
+    b.data.txs.append(b"sneaky=1")  # data no longer matches header.data_hash
+    assert b.validate_basic() is not None
+
+
+# ---------------------------------------------------------- BlockVoteSet
+
+
+def test_block_vote_wire_roundtrip():
+    vs, pvs = make_valset()
+    v = signed_block_vote(pvs[0], 3, 1, PREVOTE, b"\x11" * 32)
+    v2 = decode_block_vote(encode_block_vote(v))
+    assert v2.height == 3 and v2.round == 1 and v2.type == PREVOTE
+    assert v2.block_id == v.block_id
+    assert v2.signature == v.signature
+    assert v2.verify(CHAIN_ID, pvs[0].get_pub_key())
+
+
+def test_block_voteset_quorum_at_two_thirds_plus_one():
+    vs, pvs = make_valset(4)  # power 10 each, total 40, quorum 27
+    bvs = BlockVoteSet(CHAIN_ID, 1, 0, PREVOTE, vs)
+    block_id = b"\x22" * 32
+    for i, pv in enumerate(pvs[:2]):
+        added, err = bvs.add_vote(signed_block_vote(pv, 1, 0, PREVOTE, block_id))
+        assert added and err is None
+    assert not bvs.has_two_thirds_majority()  # 20 < 27
+    bvs.add_vote(signed_block_vote(pvs[2], 1, 0, PREVOTE, block_id))
+    assert bvs.has_two_thirds_majority()  # 30 >= 27
+    assert bvs.two_thirds_majority() == block_id
+
+
+def test_block_voteset_nil_votes_and_split():
+    vs, pvs = make_valset(4)
+    bvs = BlockVoteSet(CHAIN_ID, 1, 0, PRECOMMIT, vs)
+    block_id = b"\x33" * 32
+    bvs.add_vote(signed_block_vote(pvs[0], 1, 0, PRECOMMIT, block_id))
+    bvs.add_vote(signed_block_vote(pvs[1], 1, 0, PRECOMMIT, b""))
+    bvs.add_vote(signed_block_vote(pvs[2], 1, 0, PRECOMMIT, b""))
+    # 2/3 ANY reached (30), but no block has quorum
+    assert bvs.has_two_thirds_any()
+    assert not bvs.has_two_thirds_majority()
+    bvs.add_vote(signed_block_vote(pvs[3], 1, 0, PRECOMMIT, b""))
+    assert bvs.two_thirds_majority() == b""  # nil decision
+
+
+def test_block_voteset_rejects_dup_conflict_stranger_badsig():
+    vs, pvs = make_valset(4)
+    bvs = BlockVoteSet(CHAIN_ID, 1, 0, PREVOTE, vs)
+    block_id = b"\x44" * 32
+    v = signed_block_vote(pvs[0], 1, 0, PREVOTE, block_id)
+    assert bvs.add_vote(v)[0]
+    # exact duplicate: not added, no error
+    added, err = bvs.add_vote(v)
+    assert not added and err is None
+    # conflicting vote from the same validator
+    v2 = signed_block_vote(pvs[0], 1, 0, PREVOTE, b"\x55" * 32)
+    added, err = bvs.add_vote(v2)
+    assert not added and isinstance(err, ErrConflictingBlockVote)
+    # unknown validator
+    stranger = MockPV(hashlib.sha256(b"stranger").digest())
+    added, err = bvs.add_vote(signed_block_vote(stranger, 1, 0, PREVOTE, block_id))
+    assert not added and err is not None
+    # bad signature
+    v3 = signed_block_vote(pvs[1], 1, 0, PREVOTE, block_id)
+    v3.signature = bytes(64)
+    added, err = bvs.add_vote(v3)
+    assert not added and err is not None
+    # stake unaffected by all the rejects
+    assert not bvs.has_two_thirds_majority()
+
+
+def test_block_voteset_make_commit():
+    vs, pvs = make_valset(4)
+    bvs = BlockVoteSet(CHAIN_ID, 2, 1, PRECOMMIT, vs)
+    block_id = b"\x66" * 32
+    for pv in pvs[:3]:
+        bvs.add_vote(signed_block_vote(pv, 2, 1, PRECOMMIT, block_id))
+    commit = bvs.make_commit(block_id)
+    assert commit.block_id == block_id
+    assert commit.height() == 2 and commit.round() == 1
+    assert len(commit.precommits) == 3
+    c2 = decode_block_commit(encode_block_commit(commit))
+    assert c2.block_id == commit.block_id
+    assert len(c2.precommits) == 3
+    assert c2.precommits[0].verify(CHAIN_ID, vs.get_by_index(
+        vs.index_of(c2.precommits[0].validator_address)).pub_key)
+
+
+# --------------------------------------------------------- HeightVoteSet
+
+
+def test_height_vote_set_rounds_and_pol():
+    vs, pvs = make_valset(4)
+    hvs = HeightVoteSet(CHAIN_ID, 1, vs)
+    hvs.set_round(0)
+    block_id = b"\x77" * 32
+    for pv in pvs[:3]:
+        hvs.add_vote(signed_block_vote(pv, 1, 1, PREVOTE, block_id))
+    assert hvs.prevotes(1).has_two_thirds_majority()
+    assert hvs.pol_info() == (1, block_id)
+    assert not hvs.precommits(1).has_two_thirds_any()
+
+
+def test_height_vote_set_peer_catchup_round_bound():
+    """A peer may name at most 2 rounds beyond round+1 (reference
+    height_vote_set.go:35-115) — an unbounded-allocation guard."""
+    vs, pvs = make_valset(4)
+    hvs = HeightVoteSet(CHAIN_ID, 1, vs)
+    hvs.set_round(0)
+    # own votes (no peer id): not bounded
+    added, _ = hvs.add_vote(signed_block_vote(pvs[0], 1, 9, PREVOTE, b""))
+    assert added
+    # peer votes: rounds 5 and 6 accepted as the peer's 2 catchup rounds
+    for r in (5, 6):
+        added, _ = hvs.add_vote(
+            signed_block_vote(pvs[1], 1, r, PREVOTE, b""), peer_id="peerA"
+        )
+        assert added
+    # third catchup round from the same peer: rejected
+    added, err = hvs.add_vote(
+        signed_block_vote(pvs[1], 1, 7, PREVOTE, b""), peer_id="peerA"
+    )
+    assert not added and err is not None
+    # near rounds (<= round+1) are always accepted
+    added, _ = hvs.add_vote(
+        signed_block_vote(pvs[2], 1, 1, PREVOTE, b""), peer_id="peerA"
+    )
+    assert added
+
+
+# ------------------------------------------------------------ BlockStore
+
+
+def test_block_store_roundtrip_and_watermark():
+    vs, pvs = make_valset(4)
+    state = make_state(vs)
+    db = MemDB()
+    store = BlockStore(db)
+    assert store.height() == 0 and store.base() == 0
+
+    block = make_test_block(state)
+    block_id = block.hash()
+    bvs = BlockVoteSet(CHAIN_ID, 1, 0, PRECOMMIT, vs)
+    for pv in pvs[:3]:
+        bvs.add_vote(signed_block_vote(pv, 1, 0, PRECOMMIT, block_id))
+    seen = bvs.make_commit(block_id)
+
+    store.save_block(block, seen)
+    assert store.height() == 1 and store.base() == 1
+    loaded = store.load_block(1)
+    assert loaded is not None and loaded.hash() == block_id
+    sc = store.load_seen_commit(1)
+    assert sc is not None and sc.block_id == block_id and len(sc.precommits) == 3
+
+    # non-contiguous save refused (reference SaveBlock panics)
+    block3 = make_test_block(state, height=3)
+    with pytest.raises(ValueError):
+        store.save_block(block3, seen)
+
+    # watermark survives a reopen on the same db
+    store2 = BlockStore(db)
+    assert store2.height() == 1
+    assert store2.load_block(2) is None
+
+
+def test_block_store_extended_seen_commit():
+    vs, pvs = make_valset(4)
+    state = make_state(vs)
+    store = BlockStore(MemDB())
+    block = make_test_block(state)
+    block_id = block.hash()
+    bvs = BlockVoteSet(CHAIN_ID, 1, 0, PRECOMMIT, vs)
+    for pv in pvs[:3]:
+        bvs.add_vote(signed_block_vote(pv, 1, 0, PRECOMMIT, block_id))
+    commit = bvs.make_commit(block_id)
+    store.save_block(block, commit)
+    # late precommit folded in (consensus _extend_last_commit path)
+    late = signed_block_vote(pvs[3], 1, 0, PRECOMMIT, block_id)
+    commit.precommits.append(late)
+    store.save_seen_commit(1, commit)
+    sc = store.load_seen_commit(1)
+    assert len(sc.precommits) == 4

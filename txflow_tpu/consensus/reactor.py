@@ -16,6 +16,7 @@ rides the block request/response path.
 from __future__ import annotations
 
 import json
+import threading
 
 from ..p2p.base import CHANNEL_CONSENSUS_STATE, ChannelDescriptor, Reactor
 from ..types.block import Block, decode_block, encode_block
@@ -67,6 +68,7 @@ class ConsensusReactor(Reactor):
         consensus.broadcast_proposal = self._broadcast_proposal
         consensus.broadcast_vote = self._broadcast_vote
         consensus.broadcast_step = self._broadcast_step
+        self._gossip_stop = threading.Event()
 
     def get_channels(self) -> list[ChannelDescriptor]:
         # priority 6 (above the bulk txvote/mempool channels) and reliable:
@@ -78,8 +80,24 @@ class ConsensusReactor(Reactor):
             ChannelDescriptor(id=CHANNEL_CONSENSUS_STATE, priority=6, reliable=True)
         ]
 
+    def on_start(self) -> None:
+        # periodic position announce: push-once gossip can lose messages
+        # (e.g. sent before a peer connected); a lagging peer's reply to
+        # the announce carries the missing proposal/votes (retransmission —
+        # the liveness role of the reference's per-peer gossip routines)
+        self._gossip_stop.clear()
+        threading.Thread(
+            target=self._gossip_loop, name="consensus-gossip", daemon=True
+        ).start()
+
     def on_stop(self) -> None:
-        pass
+        self._gossip_stop.set()
+
+    def _gossip_loop(self) -> None:
+        sleep = getattr(self.consensus.config, "peer_gossip_sleep", 0.1)
+        while not self._gossip_stop.wait(sleep):
+            if self.switch is not None and self.switch.peers():
+                self._broadcast_step(self.consensus.round_state())
 
     # -- outbound (hooks called by ConsensusState) --
 
@@ -134,6 +152,27 @@ class ConsensusReactor(Reactor):
                     bytes([MSG_BLOCK_REQUEST])
                     + json.dumps({"height": my_committed + 1}).encode(),
                 )
+            else:
+                # same committed height: re-offer round data — this plus
+                # the periodic announce is what makes push-once gossip
+                # eventually deliver (liveness, r3 stall postmortem).
+                # Receivers dedup everything. Volume is bounded by need:
+                # a peer at a DIFFERENT (round, step) gets the full dump;
+                # a peer at the SAME position (which can still differ in
+                # vote knowledge) gets current-round votes, plus the block
+                # only while it could actually be missing it (<= PREVOTE:
+                # nil-prevoters without the proposal sit exactly there).
+                rs = self.consensus.round_state()
+                if d["height"] == rs.height:
+                    same_pos = d.get("round", -1) == rs.round and d.get(
+                        "step", -1
+                    ) == int(rs.step)
+                    self._send_round_data(
+                        peer,
+                        current_round_only=same_pos,
+                        with_block=(not same_pos)
+                        or d.get("step", 99) <= 4,  # RoundStep.PREVOTE
+                    )
         elif kind == MSG_PROPOSAL:
             p, block = _decode_proposal_msg(body)  # decode error stops peer
             self.consensus.add_proposal(p, block, peer_id=peer.node_id)
@@ -160,6 +199,32 @@ class ConsensusReactor(Reactor):
             )
         else:
             raise ValueError(f"unknown consensus msg type {kind}")
+
+    def _send_round_data(
+        self, peer, current_round_only: bool = False, with_block: bool = True
+    ) -> None:
+        # rate limit per peer: announces arrive on every step change AND
+        # every gossip tick; responding to each with a full round-data
+        # dump floods the reliable lane (drops!) exactly when rounds churn
+        import time as _time
+
+        now = _time.monotonic()
+        last = peer.get("consensus_rd_last", 0.0)
+        if now - last < getattr(self.consensus.config, "peer_gossip_sleep", 0.1):
+            return
+        peer.set("consensus_rd_last", now)
+        proposal, block, votes = self.consensus.current_round_data()
+        if current_round_only:
+            rs = self.consensus.round_state()
+            votes = [v for v in votes if v.round == rs.round]
+        if with_block and proposal is not None and block is not None:
+            peer.try_send(
+                CHANNEL_CONSENSUS_STATE, _encode_proposal_msg(proposal, block)
+            )
+        for v in votes:
+            peer.try_send(
+                CHANNEL_CONSENSUS_STATE, bytes([MSG_VOTE]) + encode_block_vote(v)
+            )
 
     def _send_catchup(self, peer, height: int) -> None:
         store = self.consensus.block_store

@@ -118,23 +118,12 @@ class Handshaker:
                     if responses.end_block is not None
                     else []
                 )
+                # state.app_hash is the deterministic chain digest computed
+                # inside update_state (state.execution.chain_app_hash) —
+                # nothing to reconstruct from the app
                 new_state = update_state(
                     state, block.hash(), block, responses, val_updates
                 )
-                # exact post-commit app hash for this height, best source
-                # first: persisted at commit time; recorded during this
-                # handshake's own replay; the next block's header (which
-                # carries the previous height's app hash); current app hash.
-                saved_hash = self.state_store.load_app_hash(h)
-                if saved_hash is not None:
-                    new_state.app_hash = saved_hash
-                elif h in replay_hashes:
-                    new_state.app_hash = replay_hashes[h]
-                else:
-                    nxt = self.block_store.load_block(h + 1)
-                    new_state.app_hash = (
-                        nxt.header.app_hash if nxt is not None else app_hash
-                    )
                 self.state_store.save(new_state)
                 state = new_state
 
@@ -161,17 +150,11 @@ class Handshaker:
                 res = proxy_app.consensus.commit_sync()
                 app_hash = res.data
 
-        # verify agreement when the app claims a hash (replay.go:258-266)
-        if (
-            app_height == state.last_block_height
-            and info.last_block_app_hash
-            and state.app_hash
-            and info.last_block_app_hash != state.app_hash
-        ):
-            raise AppHashMismatch(
-                f"app hash {info.last_block_app_hash.hex()} != "
-                f"state {state.app_hash.hex()} at height {app_height}"
-            )
+        # NOTE: the reference's app-hash equality check (replay.go:258-266)
+        # is deliberately absent: state.app_hash is the deterministic chain
+        # digest (state.execution.chain_app_hash), not the live app's hash;
+        # the two are incomparable under realtime per-tx commits. Replay
+        # agreement is enforced structurally by the deliver sequence above.
         return state
 
     def _exec_replay_block(self, proxy_app: AppConns, block):

@@ -59,17 +59,22 @@ class ConsensusState:
         block_store: BlockStore,
         tx_notifier=None,  # object with txs_available() -> Event (mempool)
         commitpool=None,  # fast-path commits also make blocks non-empty
+        tx_store=None,  # fast-path commit store: which vtxs we applied
         priv_val: PrivValidator | None = None,
         event_bus: EventBus | None = None,
         wal_path: str = "",
         ticker_factory=None,
-        on_commit: Callable[[State], None] | None = None,
+        on_commit: "Callable | None" = None  # (new_state, block) -> None,
     ):
         self.config = config
         self.block_exec = block_executor
         self.block_store = block_store
         self.tx_notifier = tx_notifier
         self.commitpool = commitpool
+        self.tx_store = tx_store
+        # atomic "has the fast path applied this vtx" claim (see
+        # _vtx_filter); the composition root wires the engine's claim_vtx
+        self.vtx_claimer = None
         self.priv_val = priv_val
         self.event_bus = event_bus
         self.on_commit = on_commit
@@ -153,6 +158,30 @@ class ConsensusState:
     def round_state(self) -> RoundState:
         with self._mtx:
             return self.rs
+
+    def current_round_data(self):
+        """Snapshot for retransmission gossip: (proposal, block, votes).
+        Push-once gossip loses messages sent before peers connect; the
+        reactor re-offers this data to same-height peers — the framework's
+        equivalent of the reference's per-peer gossipDataRoutine/
+        gossipVotesRoutine walks (consensus/reactor.go:465-729).
+
+        Bounded to the last 3 rounds plus any POL round: re-sending EVERY
+        round's votes grows linearly with round churn and can flood the
+        peer's reliable lane into dropping fresh proposals (r3 stall
+        postmortem #2) — the exact loss it exists to repair."""
+        with self._mtx:
+            rs = self.rs
+            votes: list[BlockVote] = []
+            if rs.votes is not None:
+                rounds = set(range(max(0, rs.round - 2), rs.round + 1))
+                pol_round, _ = rs.votes.pol_info()
+                if pol_round >= 0:
+                    rounds.add(pol_round)  # old polka: peers need it to unlock
+                for r in sorted(rounds):
+                    votes.extend(rs.votes.prevotes(r).vote_list())
+                    votes.extend(rs.votes.precommits(r).vote_list())
+            return rs.proposal, rs.proposal_block, votes
 
     def is_proposer(self) -> bool:
         with self._mtx:
@@ -575,13 +604,15 @@ class ConsensusState:
 
         failpoints.fail("consensus-after-end-height")
 
-        new_state = self.block_exec.apply_block(self.state, block)
+        new_state = self.block_exec.apply_block(
+            self.state, block, vtx_filter=self._vtx_filter()
+        )
 
         self._update_to_state(new_state)
         self._decided_once.set()
         if self.on_commit is not None:
             try:
-                self.on_commit(new_state)
+                self.on_commit(new_state, block)
             except Exception:
                 pass
         with self.height_committed:
@@ -607,12 +638,14 @@ class ConsensusState:
                 self.block_store.save_block(block, commit)
             if self.wal is not None:
                 self.wal.write_end_height(block.height)
-            new_state = self.block_exec.apply_block(state, block)
+            new_state = self.block_exec.apply_block(
+                state, block, vtx_filter=self._vtx_filter()
+            )
             self._update_to_state(new_state)
             self._decided_once.set()
             if self.on_commit is not None:
                 try:
-                    self.on_commit(new_state)
+                    self.on_commit(new_state, block)
                 except Exception:
                     pass
         with self.height_committed:
@@ -626,13 +659,18 @@ class ConsensusState:
         if vote.height != rs.height:
             if vote.height == rs.height + 1 and len(self._future_votes) < 4096:
                 # buffer next-height votes arriving while we finalize this
-                # height; released by _update_to_state. Only votes from
-                # validators of the next height's set are kept, first-wins
-                # per (validator, type, round)
+                # height; released by _update_to_state. Signature-verified
+                # BEFORE buffering — with unverified first-wins keying, one
+                # forged message per (validator, type, round) would evict
+                # the honest validator's real vote (r3 review)
                 nv = self.state.next_validators
-                if nv is not None and nv.has_address(vote.validator_address):
-                    key = (vote.validator_address, vote.type, vote.round)
-                    self._future_votes.setdefault(key, (vote, peer_id))
+                if nv is not None:
+                    _, val = nv.get_by_address(vote.validator_address)
+                    if val is not None and vote.verify(
+                        self.state.chain_id, val.pub_key
+                    ):
+                        key = (vote.validator_address, vote.type, vote.round)
+                        self._future_votes.setdefault(key, (vote, peer_id))
             elif vote.height == rs.height - 1 and vote.type == PRECOMMIT:
                 self._extend_last_commit(vote)
             return
@@ -677,6 +715,27 @@ class ConsensusState:
                 self._enter_precommit_wait(rs.height, vote.round)
             elif vote.round > rs.round and precommits.has_two_thirds_any():
                 self._enter_new_round(rs.height, vote.round)
+
+    def _vtx_filter(self):
+        """Predicate selecting vtxs the LOCAL fast path has not applied:
+        those must be delivered with the block or this app's hash diverges
+        from nodes that fast-path-committed them (BlockExecutor.apply_block
+        docstring).
+
+        When a fast-path engine is attached (``vtx_claimer``, wired by the
+        node), the claim is an atomic check-and-mark against the engine —
+        a plain tx-store lookup would race the engine's pipelined commit
+        queue and double-apply. Without a fast path every vtx is missing
+        by definition."""
+        if self.vtx_claimer is not None:
+            return self.vtx_claimer
+        if self.tx_store is None:
+            return lambda tx: True
+        import hashlib
+
+        return lambda tx: not self.tx_store.has_tx(
+            hashlib.sha256(tx).hexdigest().upper()
+        )
 
     def _extend_last_commit(self, vote: BlockVote) -> None:
         """Fold a late precommit for the committed previous height into the

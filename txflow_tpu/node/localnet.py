@@ -41,6 +41,7 @@ class LocalNet:
         enable_consensus: bool = False,
         ticker_factory=None,
         wal_dir: str = "",
+        verifier=None,
     ):
         self.chain_id = chain_id
         if priv_vals is None:
@@ -63,15 +64,20 @@ class LocalNet:
                 chain_id=chain_id,
                 val_set=self.val_set,
                 app=app_factory(),
-                # sign=False: votes are injected externally (pregenerated-
-                # vote replay, BASELINE config 1) instead of signTxRoutine
-                priv_val=pv if sign else None,
+                # a shared verifier instance (same val_set for every node)
+                # reuses one set of device epoch tables + compiled shapes
+                verifier=verifier,
+                priv_val=pv,
                 node_config=NodeConfig(
                     config=cfg,
                     gossip_batch=gossip_batch,
                     use_device_verifier=use_device_verifier,
                     mempool_broadcast=mempool_broadcast,
                     enable_consensus=enable_consensus,
+                    # sign=False: fast-path votes are injected externally
+                    # (pregenerated-vote replay, BASELINE config 1); the
+                    # node keeps its consensus identity either way
+                    sign_votes=sign,
                     ticker_factory=ticker_factory,
                     consensus_wal_path=(
                         f"{wal_dir}/node{i}-consensus.wal" if wal_dir else ""

@@ -50,6 +50,9 @@ class NodeConfig:
     # per-reactor broadcast toggles (None = follow config.mempool.broadcast)
     mempool_broadcast: bool | None = None
     vote_broadcast: bool | None = None
+    # False disables the signTxRoutine (pregenerated-vote replay benches)
+    # WITHOUT removing the node's validator identity from consensus
+    sign_votes: bool = True
     # block-path consensus (the BFT ticker fallback); off = fast path only
     enable_consensus: bool = True
     consensus_wal_path: str = ""
@@ -114,7 +117,13 @@ class Node:
         self.tx_executor = TxExecutor(
             self.proxy_app.consensus, self.mempool, self.event_bus, self.metrics
         )
-        engine_cfg = EngineConfig(use_device=nc.use_device_verifier)
+        # honor the config's engine section (batching knobs); only the
+        # device/scalar choice is a NodeConfig assembly concern
+        import dataclasses
+
+        engine_cfg = dataclasses.replace(
+            self.config.engine, use_device=nc.use_device_verifier
+        )
         if verifier is None and nc.use_device_verifier and mesh is not None:
             from ..verifier import DeviceVoteVerifier
 
@@ -154,7 +163,7 @@ class Node:
             self.state_view,
             self.mempool,
             self.tx_vote_pool,
-            priv_val=priv_val,
+            priv_val=priv_val if nc.sign_votes else None,
             broadcast=vote_bcast,
             batch_size=nc.gossip_batch,
         )
@@ -180,12 +189,15 @@ class Node:
                 self.block_store,
                 tx_notifier=self.mempool,
                 commitpool=self.commitpool,
+                tx_store=self.tx_store,
                 priv_val=priv_val,
                 event_bus=self.event_bus,
                 wal_path=nc.consensus_wal_path,
                 ticker_factory=nc.ticker_factory,
                 on_commit=self._on_block_commit,
             )
+            self.consensus.vtx_claimer = self.txflow.claim_vtx
+            self.block_executor.tx_reserved = self.txflow.is_tx_reserved
             self.consensus_reactor = ConsensusReactor(self.consensus)
             self.switch.add_reactor("consensus", self.consensus_reactor)
 
@@ -207,10 +219,11 @@ class Node:
         self.txvote_reactor.broadcast_height(height)
         self.mempool_reactor.broadcast_height(height)
 
-    def _on_block_commit(self, new_state) -> None:
+    def _on_block_commit(self, new_state, block=None) -> None:
         """Consensus commit hook: sync the fast path to the new height and
         (possibly) rotated validator set (node/node.go's implicit coupling
-        via shared state)."""
+        via shared state). Vtx double-apply protection lives in the
+        claim_vtx wiring, exercised during apply_block itself."""
         self.chain_state = new_state
         self.update_state(new_state.last_block_height, new_state.validators)
 

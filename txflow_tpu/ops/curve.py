@@ -93,7 +93,7 @@ def table_select(table, nibble):
     return (sel[..., 0, :], sel[..., 1, :], sel[..., 2, :], sel[..., 3, :])
 
 
-def double_scalar_mul(s_nibbles, h_nibbles, base_table, a_tables):
+def double_scalar_mul(s_nibbles, h_nibbles, base_table, a_tables, axis_name=None):
     """Compute [s]B + [h]A' batched, A' given by per-item PNiels tables.
 
     s_nibbles, h_nibbles: int32 [B, 64], most-significant nibble first.
@@ -105,6 +105,11 @@ def double_scalar_mul(s_nibbles, h_nibbles, base_table, a_tables):
     64 lax.fori_loop window steps of (4 doublings + 2 table additions); a
     uniform body (doubling the identity start is a no-op) keeps the compiled
     program one window-step long instead of 64.
+
+    Under shard_map (``axis_name`` set) the identity start is marked
+    device-varying with ``lax.pvary`` so the loop carry has a consistent
+    variance type — the per-vote table additions make it varying anyway —
+    and the static VMA checker can stay ON.
     """
 
     def step(w, acc):
@@ -118,9 +123,10 @@ def double_scalar_mul(s_nibbles, h_nibbles, base_table, a_tables):
         acc = pniels_add(acc, table_select(a_tables, h_nib))
         return acc
 
-    return jax.lax.fori_loop(
-        0, NWINDOWS, step, ext_identity(s_nibbles.shape[:-1])
-    )
+    init = ext_identity(s_nibbles.shape[:-1])
+    if axis_name is not None and hasattr(jax.lax, "pvary"):
+        init = tuple(jax.lax.pvary(t, axis_name) for t in init)
+    return jax.lax.fori_loop(0, NWINDOWS, step, init)
 
 
 def ext_encode(p):

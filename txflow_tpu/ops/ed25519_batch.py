@@ -129,7 +129,7 @@ def prepare_batch(
     return PreparedBatch(s_nib, h_nib, a_tables, r_y, r_sign, pre_ok)
 
 
-def verify_kernel(s_nibbles, h_nibbles, a_tables, r_y, r_sign, pre_ok):
+def verify_kernel(s_nibbles, h_nibbles, a_tables, r_y, r_sign, pre_ok, axis_name=None):
     """Device kernel: bool[B] of Go-equivalent signature validity.
 
     Jit/shard_map-able; all inputs are fixed-shape arrays. Computes
@@ -138,7 +138,8 @@ def verify_kernel(s_nibbles, h_nibbles, a_tables, r_y, r_sign, pre_ok):
     non-canonical R encodings for free.
     """
     p = curve.double_scalar_mul(
-        s_nibbles, h_nibbles, jnp.asarray(curve.BASE_TABLE), a_tables
+        s_nibbles, h_nibbles, jnp.asarray(curve.BASE_TABLE), a_tables,
+        axis_name=axis_name,
     )
     y, x_parity = curve.ext_encode(p)
     enc_match = fe.fe_is_equal_frozen(y, r_y) & (x_parity == r_sign)
@@ -227,7 +228,9 @@ def prepare_compact(
     )
 
 
-def verify_kernel_gather(s_nibbles, h_nibbles, val_idx, tables, r_y, r_sign, pre_ok):
+def verify_kernel_gather(
+    s_nibbles, h_nibbles, val_idx, tables, r_y, r_sign, pre_ok, axis_name=None
+):
     """Device kernel with on-device epoch-table gather.
 
     tables: [V, 16, 4, 32] int32, device-resident per epoch. Per-vote inputs
@@ -240,6 +243,7 @@ def verify_kernel_gather(s_nibbles, h_nibbles, val_idx, tables, r_y, r_sign, pre
         h_nibbles.astype(jnp.int32),
         jnp.asarray(curve.BASE_TABLE),
         a_tables,
+        axis_name=axis_name,
     )
     y, x_parity = curve.ext_encode(p)
     enc_match = fe.fe_is_equal_frozen(y, r_y.astype(jnp.int32)) & (

@@ -95,16 +95,46 @@ def fe_sub(a, b):
     return fe_carry(a + jnp.asarray(EIGHT_P_LIMBS) - b, passes=2)
 
 
+import os
+
+
+def _conv_mode() -> str:
+    """Limb-convolution formulation, chosen at trace time per backend.
+
+    'pad'    — 32 shifted multiply-accumulates (elementwise + static pads).
+               On TPU this fuses into pure VPU code with NO layout changes;
+               the einsum formulation spent 44% of kernel time in reshapes
+               XLA inserted around the batched matvec (r3 profile), and
+               switching to 'pad' took the verify kernel from 16k to 57k
+               votes/s at B=4096 (85k at 16384).
+    'gather' — anti-diagonal gather + einsum. Same speed as 'pad' on CPU
+               but ~3x faster to compile; kept for CPU/test runs.
+    """
+    forced = os.environ.get("TXFLOW_FE_CONV")
+    if forced:
+        return forced
+    import jax
+
+    return "pad" if jax.default_backend() == "tpu" else "gather"
+
+
 def fe_mul(a, b):
     """Product mod 2^255-19 (normalized limbs). Inputs: limbs <= 1311.
 
-    32x32 limb convolution via a static anti-diagonal gather, then the
-    2^256 ≡ 38 fold of the high 31 columns, then carries. The einsum is the
-    hot op of the whole framework — a batched [B,32]x[B,32,63] contraction
-    XLA maps onto the TPU VPU (or, via the f32 path, the MXU).
+    32x32 limb convolution (formulation per ``_conv_mode``), then the
+    2^256 ≡ 38 fold of the high 31 columns, then carries.
     """
-    bsh = jnp.where(jnp.asarray(_VALID), b[..., jnp.asarray(_IDX)], 0)  # [..., 32, 63]
-    c = jnp.einsum("...i,...ik->...k", a, bsh)  # [..., 63]
+    if _conv_mode() == "pad":
+        nd = a.ndim
+        c = None
+        for i in range(NLIMB):
+            t = jnp.pad(
+                a[..., i : i + 1] * b, [(0, 0)] * (nd - 1) + [(i, NLIMB - 1 - i)]
+            )
+            c = t if c is None else c + t
+    else:
+        bsh = jnp.where(jnp.asarray(_VALID), b[..., jnp.asarray(_IDX)], 0)
+        c = jnp.einsum("...i,...ik->...k", a, bsh)  # [..., 63]
     hi = jnp.pad(c[..., NLIMB:], [(0, 0)] * (c.ndim - 1) + [(0, 1)])
     # Worst legal input (limbs 1311) folds to < 2^31; five carry passes are
     # needed for the big limb-0 carry to fully settle (it moves up one limb

@@ -50,7 +50,7 @@ def verify_and_tally(verify_fn, axis_name: str | None = None):
     """
 
     def f(verify_inputs, tx_slot, power, prior_stake, quorum):
-        valid = verify_fn(*verify_inputs)
+        valid = verify_fn(*verify_inputs, axis_name=axis_name)
         stake = tally_kernel(valid, tx_slot, power, prior_stake.shape[0])
         if axis_name is not None:
             stake = jax.lax.psum(stake, axis_name)
@@ -90,7 +90,8 @@ def compact_step(axis_name: str | None = None):
 
     def f(s_nib, h_nib, val_idx, r_y, r_sign, pre_ok, tx_slot, tables, powers, prior_stake, quorum):
         valid = ed25519_batch.verify_kernel_gather(
-            s_nib, h_nib, val_idx, tables, r_y, r_sign, pre_ok
+            s_nib, h_nib, val_idx, tables, r_y, r_sign, pre_ok,
+            axis_name=axis_name,
         )
         power = jnp.take(powers, val_idx)
         stake = tally_kernel(valid, tx_slot, power, prior_stake.shape[0])
@@ -98,5 +99,38 @@ def compact_step(axis_name: str | None = None):
             stake = jax.lax.psum(stake, axis_name)
         total = prior_stake + stake
         return valid, total, total >= quorum
+
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def compact_step_packed_jit(axis_name: str | None = None):
+    """Shared jit of the packed-output compact step (see compact_step_packed)."""
+    return jax.jit(compact_step_packed(axis_name))
+
+
+def compact_step_packed(axis_name: str | None = None):
+    """compact_step with the three outputs packed into ONE int32 vector.
+
+    Readback layout per shard: [valid (B/n) | stake (S) | maj23 (S)], all
+    int32, concatenated. One device->host transfer instead of three — the
+    transfer setup cost dominates small reads on tunneled links (~65 ms per
+    array measured on the axon TPU path, r3), so packing roughly halves
+    end-to-end step latency. With a mesh the stake/maj segments are the
+    psum-replicated globals, repeated per shard (the host reads shard 0's).
+    """
+    inner = compact_step(axis_name)
+
+    def f(*args):
+        valid, total, maj = inner(*args)
+        total = total.astype(jnp.int32)
+        maj = maj.astype(jnp.int32)
+        if axis_name is not None and hasattr(jax.lax, "pvary"):
+            # stake/maj are psum-replicated (device-invariant); concatenating
+            # them with the device-varying valid segment needs an explicit
+            # variance cast for the VMA checker
+            total = jax.lax.pvary(total, axis_name)
+            maj = jax.lax.pvary(maj, axis_name)
+        return jnp.concatenate([valid.astype(jnp.int32), total, maj])
 
     return f

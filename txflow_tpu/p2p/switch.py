@@ -232,6 +232,16 @@ class Switch:
 
     def stop_peer_for_error(self, peer: Peer, err: object) -> None:
         """Reference StopPeerForError: tear down a misbehaving peer."""
+        import os
+        import sys
+
+        if os.environ.get("TXFLOW_P2P_QUIET") != "1":
+            # reference logs every peer stop (p2p/switch.go); a silent stop
+            # here buried real consensus bugs in r3 debugging
+            print(
+                f"p2p[{self.node_id}]: stopping peer {peer.node_id}: {err!r}",
+                file=sys.stderr,
+            )
         self.stop_peer(peer, reason=err)
 
     # -- message plumbing --

@@ -57,10 +57,9 @@ def sharded_verify_and_tally(mesh: Mesh, axis_name: str = VOTE_AXIS):
         mesh=mesh,
         in_specs=(vote_specs, P(axis_name), P(axis_name), P(), P()),
         out_specs=(P(axis_name), P(), P()),
-        # the scan carry in double_scalar_mul starts replicated and becomes
-        # vote-varying, which the static VMA checker rejects; correctness of
-        # the replicated outputs is guaranteed by the psum.
-        check_vma=False,
+        # VMA checker ON: the scalar-mul loop carry is pvary'd to the vote
+        # axis at init (ops.curve.double_scalar_mul), so its variance type
+        # is consistent throughout.
     )
     return jax.jit(f)
 
@@ -92,6 +91,22 @@ def sharded_compact_step(mesh: Mesh, axis_name: str = VOTE_AXIS):
         mesh=mesh,
         in_specs=(v, v, v, v, v, v, v, P(), P(), P(), P()),
         out_specs=(v, P(), P()),
-        check_vma=False,  # same scan-carry VMA caveat as above
+    )
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=8)
+def sharded_compact_step_packed_cached(mesh: Mesh, axis_name: str = VOTE_AXIS):
+    """Packed-output sharded step (single D2H readback; tally.compact_step_
+    packed docstring). Per-shard output [B/n + 2*S] int32, sharded over the
+    vote axis -> host sees [B + 2*S*n]; the stake/maj segments repeat the
+    psum-replicated global per shard."""
+    inner = tally.compact_step_packed(axis_name=axis_name)
+    v = P(axis_name)
+    f = shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(v, v, v, v, v, v, v, P(), P(), P(), P()),
+        out_specs=v,
     )
     return jax.jit(f)

@@ -46,10 +46,17 @@ class IngestLogPool:
         self._cond.notify_all()
 
     def _log_compact(self) -> None:
-        """Drop the longest dead prefix once it crosses the threshold."""
-        n = 0
-        items = self._items
+        """Drop the longest dead prefix once it crosses the threshold.
+
+        Amortized O(1) per removal: the (O(prefix)) scan only runs when the
+        log has at least COMPACT_THRESHOLD more entries than live items —
+        scanning from 0 on EVERY bulk removal measured at 0.9 ms/call with
+        a 16k-vote log (r3 step profile), serializing the commit path."""
         log = self._log
+        items = self._items
+        if len(log) - len(items) < COMPACT_THRESHOLD:
+            return
+        n = 0
         while n < len(log) and log[n] not in items:
             n += 1
         if n >= COMPACT_THRESHOLD:

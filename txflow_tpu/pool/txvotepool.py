@@ -47,6 +47,7 @@ class _PoolVote:
     height: int
     vote: TxVote
     senders: set[int] = field(default_factory=set)
+    size: int = 0  # encoded wire size, cached so removals never re-encode
 
 
 class TxVotePool(IngestLogPool):
@@ -148,7 +149,7 @@ class TxVotePool(IngestLogPool):
                 raise ErrTxInCache()
             if self.wal is not None and write_wal:
                 self.wal.write(encoded)
-            entry = _PoolVote(self.height, vote, {tx_info.sender_id})
+            entry = _PoolVote(self.height, vote, {tx_info.sender_id}, vote_size)
             self._votes[key] = entry
             self._log_append(key)
             self._votes_bytes += vote_size
@@ -201,7 +202,7 @@ class TxVotePool(IngestLogPool):
             for k in keys:
                 entry = self._votes.pop(k, None)
                 if entry is not None:
-                    self._votes_bytes -= len(encode_tx_vote(entry.vote))
+                    self._votes_bytes -= entry.size
                 if cache_too:
                     self.cache.remove(k)
             self._log_compact()
@@ -218,7 +219,7 @@ class TxVotePool(IngestLogPool):
                 self.cache.push(k)  # committed votes stay cached
                 entry = self._votes.pop(k, None)
                 if entry is not None:
-                    self._votes_bytes -= len(encode_tx_vote(entry.vote))
+                    self._votes_bytes -= entry.size
             self._log_compact()
             if len(self._votes) > 0:
                 self._notify_txs_available()

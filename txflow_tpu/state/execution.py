@@ -22,7 +22,7 @@ from __future__ import annotations
 import time
 
 from ..abci.proxy import AppConnConsensus
-from ..abci.types import RequestBeginBlock, RequestEndBlock
+from ..abci.types import RequestBeginBlock, RequestEndBlock, ResponseDeliverTx
 from ..pool.mempool import Mempool
 from ..types.block import Block
 from ..types.block_vote import BlockCommit, BlockVoteSet, PRECOMMIT
@@ -91,6 +91,9 @@ class BlockExecutor:
         self.commitpool = commitpool
         self.event_bus = event_bus
         self.evidence_pool = evidence_pool
+        # optional fast-path hook: predicate(tx) -> bool, True when the
+        # fast path owns the tx (proposals then leave it out of block.Txs)
+        self.tx_reserved = None
 
     def set_event_bus(self, bus: EventBus) -> None:
         self.event_bus = bus
@@ -102,6 +105,10 @@ class BlockExecutor:
         proposer_address: bytes,
     ) -> Block:
         txs = self.mempool.reap_max_bytes_max_gas(MAX_BLOCK_BYTES, -1)
+        if self.tx_reserved is not None:
+            # leave fast-path-owned txs to the fast path: they re-enter
+            # blocks as Vtxs once committed (see is_tx_reserved)
+            txs = [tx for tx in txs if not self.tx_reserved(tx)]
         vtxs = self.commitpool.reap_max_txs(-1)  # ALL fast-path commits
         return state.make_block(height, txs, vtxs, last_commit, proposer_address)
 
@@ -149,13 +156,24 @@ class BlockExecutor:
 
     # -- application (reference ApplyBlock :124-187) --
 
-    def apply_block(self, state: State, block: Block) -> State:
+    def apply_block(self, state: State, block: Block, vtx_filter=None) -> State:
+        """Execute + commit a block.
+
+        vtx_filter: optional predicate(tx) -> bool selecting Vtxs to DELIVER
+        to the app before the block's Txs. Vtxs are normally never
+        re-delivered (their effects entered via per-tx fast-path commits,
+        types/block.go:292-298) — but a node that did NOT fast-path-commit
+        some vtx (block catchup; a commit that outran local vote quorum)
+        must deliver it here or its app hash diverges from the network's
+        (r3 catchup postmortem). The filter is 'has the local fast path
+        already applied this tx'.
+        """
         err = self.validate_block(state, block)
         if err:
             raise ValueError(f"invalid block: {err}")
         block_id = block.hash()
 
-        responses = self._exec_block_on_proxy_app(block)
+        responses = self._exec_block_on_proxy_app(block, vtx_filter)
 
         failpoints.fail("block-after-exec")
 
@@ -172,13 +190,16 @@ class BlockExecutor:
 
         new_state = update_state(state, block_id, block, responses, val_updates)
 
-        # app Commit under the mempool lock (:195-239)
-        app_hash = self._commit(new_state, block, responses)
-        self.state_store.save_app_hash(block.height, app_hash)
+        # app Commit under the mempool lock (:195-239). NOTE: the commit's
+        # hash does NOT feed state.app_hash — see update_state; with
+        # realtime per-tx commits mutating the app between blocks, the live
+        # app hash at commit time is a wall-clock cutoff no catch-up node
+        # can reproduce (the reference validates exactly that and would
+        # fork, r3 postmortem; its snapshot never ran this path).
+        self._commit(new_state, block, responses)
 
         failpoints.fail("block-after-commit")
 
-        new_state.app_hash = app_hash
         self.state_store.save(new_state)
 
         failpoints.fail("block-after-save")
@@ -186,8 +207,12 @@ class BlockExecutor:
         self._fire_events(block, responses, val_updates)
         return new_state
 
-    def _exec_block_on_proxy_app(self, block: Block) -> ABCIResponses:
-        """BeginBlock / DeliverTx* / EndBlock (:246-310). Vtxs excluded."""
+    def _exec_block_on_proxy_app(self, block: Block, vtx_filter=None) -> ABCIResponses:
+        """BeginBlock / [missed Vtxs] / DeliverTx* / EndBlock (:246-310).
+
+        Vtx responses are NOT part of ABCIResponses: the results hash
+        covers block.Txs only, matching nodes that applied the vtxs via
+        the fast path."""
         self.proxy_app.begin_block_sync(
             RequestBeginBlock(
                 hash=block.hash(),
@@ -195,8 +220,23 @@ class BlockExecutor:
                 proposer_address=block.header.proposer_address,
             )
         )
+        if vtx_filter is not None:
+            for tx in block.vtxs:
+                if vtx_filter(tx):
+                    self.proxy_app.deliver_tx_async(tx)
         deliver = []
         for tx in block.txs:
+            if vtx_filter is not None and not vtx_filter(tx):
+                # the local fast path already applied this tx (it slipped
+                # into block.Txs despite the proposer-side reservation —
+                # commit landed between reap and apply). Skip the delivery
+                # and synthesize an OK response so the results hash stays
+                # deterministic; the framework's ABCI contract therefore
+                # requires fast-path-eligible DeliverTx responses to be
+                # (code OK, empty data) — per-tx results flow through the
+                # fast path's own commit events instead.
+                deliver.append(ResponseDeliverTx())
+                continue
             deliver.append(self.proxy_app.deliver_tx_async(tx).value)
         self.proxy_app.flush()
         end = self.proxy_app.end_block_sync(RequestEndBlock(height=block.height))
@@ -208,6 +248,11 @@ class BlockExecutor:
             self.proxy_app.flush()
             commit_res = self.proxy_app.commit_sync()
             self.mempool.update(block.height, block.txs, responses.deliver_tx)
+            # purge vtxs too: a vtx this node never fast-path-committed
+            # would otherwise linger in its mempool and get fast-committed
+            # (= applied) a second time after the block already carried it
+            if block.vtxs:
+                self.mempool.update(block.height, block.vtxs)
             # defect fix: purge included Vtxs so they are not re-proposed
             self.commitpool.lock()
             try:
@@ -243,6 +288,26 @@ class BlockExecutor:
             )
 
 
+def chain_app_hash(prev_app_hash: bytes, block_id: bytes, results_hash: bytes) -> bytes:
+    """Deterministic per-height app-hash chain.
+
+    The reference sets State.AppHash from the live app's Commit response —
+    but with the fast path committing txs in realtime, the live app hash
+    at a block's commit instant is a WALL-CLOCK cutoff: it includes
+    whichever per-tx commits happened to land first, which no catch-up or
+    replaying node can reproduce (and which can differ between live
+    validators — the reference would fork on its own AppHash check).
+    The rebuild's chain app hash is instead a pure function of block
+    history: H(prev || block_id || results_hash). The live ABCI app's own
+    hash remains observable via the fast path's commit events and the
+    status RPC, but is not consensus-validated — it cannot be, under
+    realtime commits.
+    """
+    from ..crypto.hash import sha256
+
+    return sha256(b"txflow-app" + prev_app_hash + block_id + results_hash)[:20]
+
+
 def update_state(
     state: State,
     block_id: bytes,
@@ -258,6 +323,7 @@ def update_state(
         # changes apply at height H+2 (reference :404-407)
         last_height_vals_changed = block.height + 1 + 1
     n_val_set = n_val_set.increment_proposer_priority(1)
+    results_hash = responses.results_hash()
     return State(
         chain_id=state.chain_id,
         last_block_height=block.height,
@@ -268,8 +334,8 @@ def update_state(
         next_validators=n_val_set,
         last_validators=state.validators.copy(),
         last_height_validators_changed=last_height_vals_changed,
-        app_hash=b"",  # filled after app Commit
-        last_results_hash=responses.results_hash(),
+        app_hash=chain_app_hash(state.app_hash, block_id, results_hash),
+        last_results_hash=results_hash,
     )
 
 

@@ -117,13 +117,3 @@ class StateStore:
 
     def load_abci_responses(self, height: int) -> bytes | None:
         return self.db.get(b"abciResponsesKey:%d" % height)
-
-    def save_app_hash(self, height: int, app_hash: bytes) -> None:
-        """Post-commit app hash per height, written immediately after the
-        app Commit so handshake can reconstruct State.app_hash exactly when
-        a crash lands between app commit and the state save (the window the
-        'block-after-commit' failpoint simulates)."""
-        self.db.set(b"appHashKey:%d" % height, app_hash)
-
-    def load_app_hash(self, height: int) -> bytes | None:
-        return self.db.get(b"appHashKey:%d" % height)

@@ -74,27 +74,34 @@ class TxStore:
             raise ValueError("TxStore can only save a non-nil TxVoteSet")
         tx_hash = vote_set.tx_hash
         with self._mtx:
-            self.db.set(_tx_key(tx_hash), _encode_votes(vote_set.get_votes()))
+            votes = vote_set.get_votes()
+            votes_blob = _encode_votes(votes)
+            hash_b = tx_hash.encode()
+            self.db.set(b"H:" + hash_b, votes_blob)
             if commit is None and vote_set.has_two_thirds_majority():
-                commit = vote_set.make_commit()
-            if commit is not None:
+                # the commit certificate is exactly the set's votes (a
+                # TxVoteSet only ever holds votes for its own tx), so the
+                # row would be byte-identical to H: — load_tx_commit falls
+                # back to the H: row instead of storing the blob twice
+                pass
+            elif commit is not None:
                 self.db.set(
-                    _commit_key(tx_hash),
+                    b"C:" + hash_b,
                     _encode_votes([cs.to_vote() for cs in commit.commits]),
                 )
             # commit-order log: S:<seq> -> tx_hash, so crash recovery can
             # replay fast-path commits in the exact order they happened
             # (the reference stores no order; its recovery story for the
             # fast path is correspondingly incomplete — SURVEY §0)
-            if not self.db.has(b"O:" + tx_hash.encode()):
-                self.db.set(b"S:%016d" % self._seq, tx_hash.encode())
-                self.db.set(b"O:" + tx_hash.encode(), b"%d" % self._seq)
+            if not self.db.has(b"O:" + hash_b):
+                self.db.set(b"S:%016d" % self._seq, hash_b)
+                self.db.set(b"O:" + hash_b, b"%d" % self._seq)
                 self._seq += 1
-                self.db.set(b"TxStoreSeq", json.dumps({"seq": self._seq}).encode())
+                self.db.set(b"TxStoreSeq", b'{"seq": %d}' % self._seq)
             h = vote_set.height()
             if h > self._height:
                 self._height = h
-            self.db.set_sync(_HEIGHT_KEY, json.dumps({"height": self._height}).encode())
+                self.db.set_sync(_HEIGHT_KEY, b'{"height": %d}' % h)
 
     # -- load (reference :54-80) --
 
@@ -117,6 +124,11 @@ class TxStore:
 
     def load_tx_commit(self, tx_hash: str) -> Commit | None:
         raw = self.db.get(_commit_key(tx_hash))
+        if raw is None:
+            # quorum certificates are stored once under H: (identical vote
+            # list — see save_tx); a distinct C: row exists only for
+            # explicitly supplied commits
+            raw = self.db.get(_tx_key(tx_hash))
         if raw is None:
             return None
         votes = _decode_votes(raw)

@@ -23,6 +23,10 @@ MAX_SIGNATURE_SIZE = 64
 
 _ZERO_TXKEY = bytes(32)
 
+_SEMANTIC_FIELDS = frozenset(
+    ("height", "tx_hash", "tx_key", "timestamp_ns", "validator_address", "signature")
+)
+
 
 def canonical_sign_bytes(
     chain_id: str, height: int, tx_hash: str, timestamp_ns: int
@@ -56,11 +60,34 @@ class TxVote:
     timestamp_ns: int = field(default_factory=_time.time_ns)
     validator_address: bytes = b""
     signature: bytes | None = None
+    # encode caches: a signed vote is immutable, and re-deriving sign bytes
+    # and wire bytes per engine step measured as a top host cost at bench
+    # scale (r3 step profile). Signers mutate fields BEFORE the first
+    # encode, so lazy first-use caching is safe; ``copy()`` drops them.
+    _sb_cache: tuple | None = field(
+        default=None, repr=False, compare=False
+    )
+    _wire_cache: bytes | None = field(default=None, repr=False, compare=False)
+
+    def __setattr__(self, name, value):
+        # any semantic-field write invalidates the encode caches, so even
+        # post-signing tampering (byzantine tests) can never serve stale
+        # bytes
+        if name in _SEMANTIC_FIELDS:
+            object.__setattr__(self, "_sb_cache", None)
+            object.__setattr__(self, "_wire_cache", None)
+        object.__setattr__(self, name, value)
 
     def sign_bytes(self, chain_id: str) -> bytes:
-        return canonical_sign_bytes(
+        c = self._sb_cache
+        if c is not None and c[0] == chain_id:
+            return c[1]
+        sb = canonical_sign_bytes(
             chain_id, self.height, self.tx_hash, self.timestamp_ns
         )
+        if self.signature is not None:  # immutable once signed
+            self._sb_cache = (chain_id, sb)
+        return sb
 
     def verify(self, chain_id: str, pub_key: bytes) -> str | None:
         """Returns None if valid, else an error string (types/tx_vote.go:110-119)."""
@@ -90,7 +117,7 @@ class TxVote:
         return len(encode_tx_vote(self))
 
     def copy(self) -> "TxVote":
-        return replace(self)
+        return replace(self, _sb_cache=None, _wire_cache=None)
 
     def vote_key(self) -> bytes:
         """sha256(signature) — dedup cache key (txvotepool/txvotepool.go:467-469)."""
@@ -99,6 +126,8 @@ class TxVote:
 
 def encode_tx_vote(vote: TxVote) -> bytes:
     """Amino MarshalBinaryBare of the full TxVote struct (WAL/wire form)."""
+    if vote._wire_cache is not None:
+        return vote._wire_cache
     body = bytearray()
     if vote.height != 0:
         body += amino.field_key(1, amino.TYP3_VARINT)
@@ -118,7 +147,10 @@ def encode_tx_vote(vote: TxVote) -> bytes:
     if vote.signature:
         body += amino.field_key(6, amino.TYP3_BYTELEN)
         body += amino.length_prefixed(vote.signature)
-    return bytes(body)
+    out = bytes(body)
+    if vote.signature is not None:  # immutable once signed
+        vote._wire_cache = out
+    return out
 
 
 def decode_tx_vote(data: bytes) -> TxVote:

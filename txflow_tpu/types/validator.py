@@ -82,8 +82,12 @@ class ValidatorSet:
         return self._by_address.get(address, -1)
 
     def copy(self) -> "ValidatorSet":
-        vs = ValidatorSet(self.validators)
-        return vs
+        # deep copy: increment_proposer_priority mutates Validator objects
+        # in ITS copy; sharing them would smear rotation state across every
+        # holder of the set (state snapshots, engines, round states) and
+        # desynchronize proposer selection between nodes (r3 livelock
+        # postmortem: split prevotes, rounds looping forever)
+        return ValidatorSet([v.copy() for v in self.validators])
 
     def hash(self) -> bytes:
         """Deterministic digest of (address, pub_key, power) triples, used

@@ -96,6 +96,11 @@ class EngineConfig:
     # device-sized batches instead of overhead-dominated tiny kernel calls
     min_batch: int = 256
     batch_wait: float = 0.004
+    # overlap commit side-effects (TxStore persist, ABCI execute, pool
+    # purge) with the next device verify call via a per-engine committer
+    # thread (SURVEY §7 hard-part 5); False = reference-faithful inline
+    # commits inside the step
+    pipeline_commits: bool = True
 
 
 @dataclass

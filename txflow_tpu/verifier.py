@@ -146,25 +146,40 @@ class DeviceVoteVerifier:
                 "(device tally is int32)"
             )
         self.buckets = buckets
+        # the engine must not drain batches beyond the largest bucket:
+        # past it, bucket_size degrades to exact-size rounding and every
+        # new batch size triggers a fresh (minutes-long on TPU) compile
+        self.max_batch = max(buckets)
         self.mesh = mesh
         import jax
 
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            from .parallel.mesh import sharded_compact_step_cached
+            from .parallel.mesh import sharded_compact_step_packed_cached
 
             self._n_shards = mesh.size
-            self._fn = sharded_compact_step_cached(mesh)
+            self._fn = sharded_compact_step_packed_cached(mesh)
             # pre-replicate the per-epoch device constants across the mesh
             rep = NamedSharding(mesh, PartitionSpec())
             self._tables_dev = jax.device_put(self.epoch.tables, rep)
             self._powers_dev = jax.device_put(self._powers, rep)
         else:
             self._n_shards = 1
-            self._fn = tally.compact_step_jit()
+            self._fn = tally.compact_step_packed_jit()
             self._tables_dev = self.epoch.device_tables()
             self._powers_dev = jax.numpy.asarray(self._powers)
+
+    def warmup(self, n: int = 1) -> None:
+        """Compile the kernel for the bucket shapes of an n-vote batch.
+
+        Call ONCE before concurrent engines share this verifier: N threads
+        racing to compile the same uncached shape is at best N redundant
+        ~90 s compiles and at worst a remote-compile transport error
+        (observed on the tunneled axon backend, r3)."""
+        self.verify_and_tally(
+            [b""] * n, [b""] * n, np.zeros(n, np.int64), np.zeros(n, np.int64), 1
+        )
 
     def verify_and_tally(
         self,
@@ -204,14 +219,24 @@ class DeviceVoteVerifier:
             prior[:n_slots] = np.asarray(prior_stake, dtype=np.int32)
         q = np.int32(self.val_set.quorum_power() if quorum is None else quorum)
 
-        valid, stake, maj23 = self._fn(
-            s_nib, h_nib, vidx, r_y, r_sign, pre_ok, slot,
-            self._tables_dev, self._powers_dev, prior, q,
+        packed = np.asarray(
+            self._fn(
+                s_nib, h_nib, vidx, r_y, r_sign, pre_ok, slot,
+                self._tables_dev, self._powers_dev, prior, q,
+            )
         )
+        # ONE readback, per-shard layout [valid b/n | stake S | maj S]
+        # (tally.compact_step_packed); stake/maj repeat the replicated
+        # global per shard — take shard 0's copy
+        rows = packed.reshape(self._n_shards, -1)
+        bs = b // self._n_shards
+        valid = rows[:, :bs].reshape(-1).astype(bool)
+        stake = rows[0, bs : bs + b_slots]
+        maj23 = rows[0, bs + b_slots :].astype(bool)
         return TallyResult(
-            np.asarray(valid)[:n],
-            np.asarray(stake)[:n_slots],
-            np.asarray(maj23)[:n_slots],
+            valid[:n],
+            stake[:n_slots].astype(np.int64),
+            maj23[:n_slots],
             ~keep,
         )
 
