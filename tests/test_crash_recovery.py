@@ -1,0 +1,247 @@
+"""Crash-consistency tests: armed failpoints kill a node mid-commit; a
+restart over the same durable artifacts (FileDB stores + pool WALs +
+consensus WAL) must reconstruct identical state with no double delivery.
+
+Mirrors the reference's crashingWAL restart loops and handshake replay
+matrix (consensus/replay_test.go:113-180, 488-527) and the fail.Fail()
+crash hooks compiled into the commit paths (txflowstate/execution.go:87,
+95; state/execution.go:138-180; consensus/state.go:1277-1334). The
+restart model: durable stores survive, the ABCI app restarts EMPTY and is
+rebuilt by the Handshaker (block replay incl. Vtxs + fast-path commit
+redelivery in commit order) — so "no double delivery" is an exactly-once
+assertion over the rebuilt app's deliver stream.
+"""
+
+import conftest  # noqa: F401
+
+import collections
+import hashlib
+import time
+
+import pytest
+
+from txflow_tpu.abci.kvstore import KVStoreApplication
+from txflow_tpu.node.node import Node, NodeConfig
+from txflow_tpu.store.db import FileDB
+from txflow_tpu.types import TxVote
+from txflow_tpu.types.priv_validator import MockPV
+from txflow_tpu.types.validator import Validator, ValidatorSet
+from txflow_tpu.utils import failpoints
+from txflow_tpu.utils.config import test_config as make_test_config
+
+CHAIN_ID = "test-crash"
+
+
+class CountingKVStore(KVStoreApplication):
+    """kvstore that records every delivered tx (exactly-once oracle)."""
+
+    def __init__(self):
+        super().__init__()
+        self.delivered = collections.Counter()
+
+    def deliver_tx(self, tx):
+        self.delivered[bytes(tx)] += 1
+        return super().deliver_tx(tx)
+
+
+def wait_until(pred, timeout=20.0, poll=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    return False
+
+
+def build_node(tmp_path, enable_consensus=False, app=None):
+    """Single-validator node over durable artifacts under tmp_path."""
+    pv = MockPV(hashlib.sha256(b"crash-val").digest())
+    vs = ValidatorSet([Validator.from_pub_key(pv.get_pub_key(), 10)])
+    cfg = make_test_config()
+    cfg.consensus.skip_timeout_commit = True
+    cfg.mempool.wal_dir = str(tmp_path)
+    node = Node(
+        node_id="crash-node",
+        chain_id=CHAIN_ID,
+        val_set=vs,
+        app=app or CountingKVStore(),
+        priv_val=pv,
+        node_config=NodeConfig(
+            config=cfg,
+            use_device_verifier=False,
+            enable_consensus=enable_consensus,
+            consensus_wal_path=str(tmp_path / "consensus.wal"),
+        ),
+        tx_store_db=FileDB(str(tmp_path / "txstore.db")),
+        state_db=FileDB(str(tmp_path / "state.db")),
+        block_db=FileDB(str(tmp_path / "blocks.db")),
+    )
+    return node, pv
+
+
+def sign_tx_vote(pv, tx):
+    key = hashlib.sha256(tx).digest()
+    v = TxVote(
+        height=0,
+        tx_hash=key.hex().upper(),
+        tx_key=key,
+        validator_address=pv.get_address(),
+    )
+    pv.sign_tx_vote(CHAIN_ID, v)
+    return v
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.disarm()
+    yield
+    failpoints.disarm()
+
+
+# -------------------------------------------------- fast-path crash points
+
+
+@pytest.mark.parametrize("point", ["txflow-before-commit", "txflow-after-commit"])
+def test_engine_crash_then_restart_replays_exactly_once(tmp_path, point):
+    """Kill the fast path around the app Commit; the restarted node's app
+    is rebuilt with each committed tx delivered exactly once, in the
+    commit order persisted by the TxStore."""
+    node, pv = build_node(tmp_path)
+    node.start()
+    committed = [b"pre-%d=v" % i for i in range(3)]
+    for tx in committed:
+        node.broadcast_tx(tx)
+        node.tx_vote_pool.check_tx(sign_tx_vote(pv, tx))
+    assert wait_until(lambda: all(node.is_committed(t) for t in committed))
+
+    failpoints.arm(point)
+    victim = b"victim=v"
+    node.broadcast_tx(victim)
+    node.tx_vote_pool.check_tx(sign_tx_vote(pv, victim))
+    assert wait_until(lambda: failpoints.fired(point)), "failpoint must fire"
+    node.stop()  # crash: partial commit state on disk
+    failpoints.disarm()
+
+    # restart over the same artifacts; handshake rebuilds the app
+    app2 = CountingKVStore()
+    node2, pv = build_node(tmp_path, app=app2)
+    node2.start()
+    try:
+        # pre-crash commits: exactly once each
+        for tx in committed:
+            assert node2.is_committed(tx)
+            assert app2.delivered[tx] == 1, f"{tx} delivered {app2.delivered[tx]}x"
+        # the victim: at most once (before-commit: save_tx may or may not
+        # have landed; after-commit: must be there exactly once)
+        assert app2.delivered[victim] <= 1
+        if point == "txflow-after-commit":
+            assert node2.is_committed(victim)
+            assert app2.delivered[victim] == 1
+        # commit order replay preserved the persisted order prefix
+        order = node2.tx_store.committed_hashes_in_order()
+        want = [hashlib.sha256(t).hexdigest().upper() for t in committed]
+        assert order[: len(want)] == want
+        # the node still works: a fresh tx commits
+        fresh = b"fresh=v"
+        node2.broadcast_tx(fresh)
+        node2.tx_vote_pool.check_tx(sign_tx_vote(pv, fresh))
+        assert wait_until(lambda: node2.is_committed(fresh))
+        assert app2.delivered[fresh] == 1
+    finally:
+        node2.stop()
+
+
+# ------------------------------------------------- block-path crash points
+
+
+@pytest.mark.parametrize(
+    "point",
+    [
+        "consensus-after-save-block",
+        "consensus-after-end-height",
+        "block-after-exec",
+        "block-after-commit",
+        "block-after-save",
+    ],
+)
+def test_consensus_crash_then_restart_resumes_chain(tmp_path, point):
+    """Kill consensus at every commit-path failpoint; the restarted node's
+    handshake reconciles app/store/state heights and block production
+    resumes with no tx delivered twice (single-validator chain: quorum of
+    one, so the node commits blocks alone)."""
+    node, pv = build_node(tmp_path, enable_consensus=True)
+    node.start()
+    txs = [b"blk-%d=v" % i for i in range(3)]
+    for tx in txs:
+        node.broadcast_tx(tx)
+        node.tx_vote_pool.check_tx(sign_tx_vote(pv, tx))
+    assert wait_until(lambda: all(node.is_committed(t) for t in txs))
+    assert node.consensus.wait_for_height(2, timeout=30)
+
+    failpoints.arm(point)
+    assert wait_until(lambda: failpoints.fired(point), timeout=30), (
+        f"{point} must fire during block production"
+    )
+    crash_store_h = node.block_store.height()
+    node.stop()
+    failpoints.disarm()
+
+    app2 = CountingKVStore()
+    node2, pv = build_node(tmp_path, enable_consensus=True, app=app2)
+    node2.start()
+    try:
+        st = node2.consensus.state
+        # handshake reconciled the three height domains
+        assert st.last_block_height == node2.block_store.height()
+        assert node2.block_store.height() >= crash_store_h - 1
+        # every fast-committed tx delivered exactly once into the new app
+        for tx in txs:
+            assert app2.delivered[tx] == 1, f"{tx} delivered {app2.delivered[tx]}x"
+        # chain liveness: new blocks after restart
+        h = st.last_block_height
+        assert node2.consensus.wait_for_height(h + 2, timeout=30), (
+            "block production must resume after crash recovery"
+        )
+        # and the fast path still commits new txs exactly once
+        fresh = b"post-crash=v"
+        node2.broadcast_tx(fresh)
+        node2.tx_vote_pool.check_tx(sign_tx_vote(pv, fresh))
+        assert wait_until(lambda: node2.is_committed(fresh))
+        assert app2.delivered[fresh] == 1
+    finally:
+        node2.stop()
+
+
+def test_handshaker_state_catchup_is_deterministic(tmp_path):
+    """Crash between block save and state save ('consensus-after-save-
+    block'), restart TWICE: both restarts must converge to the identical
+    state bytes (the chain app hash is a pure function of block history)."""
+    node, pv = build_node(tmp_path, enable_consensus=True)
+    node.start()
+    node.broadcast_tx(b"det=v")
+    node.tx_vote_pool.check_tx(sign_tx_vote(pv, b"det=v"))
+    assert wait_until(lambda: node.is_committed(b"det=v"))
+    assert node.consensus.wait_for_height(2, timeout=30)
+    failpoints.arm("consensus-after-save-block")
+    assert wait_until(lambda: failpoints.fired("consensus-after-save-block"), timeout=30)
+    node.stop()
+    failpoints.disarm()
+
+    node2, _ = build_node(tmp_path, enable_consensus=True)
+    node2.start()
+    state_a = node2.consensus.state.bytes()
+    h_a = node2.consensus.state.last_block_height
+    node2.stop()
+
+    node3, _ = build_node(tmp_path, enable_consensus=True)
+    node3.start()
+    try:
+        # heights can only have advanced between restarts if node2 ran
+        # briefly; compare at the common height via the state store's
+        # persisted snapshot determinism: same artifacts -> same state
+        if node3.consensus.state.last_block_height == h_a:
+            assert node3.consensus.state.bytes() == state_a
+        else:
+            assert node3.consensus.state.last_block_height >= h_a
+    finally:
+        node3.stop()

@@ -29,6 +29,13 @@ class AppHashMismatch(Exception):
 
 
 class Handshaker:
+    # how far back the restart dedup walk looks: a tx only recurs across
+    # blocks within the commitpool race window (a couple of heights), and
+    # fast commits re-enter a block within a couple of heights; 256 is
+    # orders of magnitude of headroom while keeping restarts O(1) in
+    # chain length
+    DEDUP_WINDOW = 256
+
     def __init__(
         self,
         state_store: StateStore,
@@ -74,15 +81,43 @@ class Handshaker:
                 ]
             )
 
-        # replay store blocks the app has not seen (replay.go:409-498)
+        # replay store blocks the app has not seen (replay.go:409-498).
+        # A tx can legitimately appear twice across blocks (reaped into
+        # block.Txs, then fast-path-committed and re-carried as a later
+        # block's Vtx — the live nodes deduped the second delivery via
+        # the engine claim); the replay applies the same exactly-once rule
+        # with a delivered-set spanning the whole replay.
         app_hash = info.last_block_app_hash
         replay_hashes: dict[int, bytes] = {}  # height -> post-commit app hash
         replay_responses: dict[int, object] = {}  # height -> ABCIResponses
+        import hashlib as _hl
+
+        # ONE bounded chain walk seeds both dedup sets: full-chain scans
+        # per restart are O(history) for nothing — a tx only recurs across
+        # blocks within the short commitpool race window, and the fast-path
+        # redelivery exclusion likewise only concerns recent blocks (every
+        # fast commit re-enters a block within a couple of heights).
+        walk_base = max(1, store_height - self.DEDUP_WINDOW)
+        block_txs: set[bytes] = set()
+        for h in range(walk_base, store_height + 1):
+            b = self.block_store.load_block(h)
+            if b is not None:
+                for tx in list(b.txs) + list(b.vtxs):
+                    block_txs.add(_hl.sha256(tx).digest())
+        # "already delivered" = txs of blocks the app has seen
+        delivered: set[bytes] = set()
+        for h in range(walk_base, app_height + 1):
+            b = self.block_store.load_block(h)
+            if b is not None:
+                for tx in list(b.txs) + list(b.vtxs):
+                    delivered.add(_hl.sha256(tx).digest())
         for h in range(app_height + 1, store_height + 1):
             block = self.block_store.load_block(h)
             if block is None:
                 raise ValueError(f"missing block {h} during handshake replay")
-            app_hash, responses = self._exec_replay_block(proxy_app, block)
+            app_hash, responses = self._exec_replay_block(
+                proxy_app, block, delivered
+            )
             replay_hashes[h] = app_hash
             replay_responses[h] = responses
             self.n_blocks_replayed += 1
@@ -130,18 +165,10 @@ class Handshaker:
         # re-apply fast-path commits made after the last block's Vtxs were
         # drained (their effects are in no block yet)
         if self.tx_store is not None and self.mempool is not None:
-            replayed_from_blocks: set[bytes] = set()
-            for h in range(1, store_height + 1):
-                b = self.block_store.load_block(h)
-                if b is not None:
-                    for tx in list(b.txs) + list(b.vtxs):
-                        import hashlib
-
-                        replayed_from_blocks.add(hashlib.sha256(tx).digest())
             for tx_hash in self.tx_store.committed_hashes_in_order():
                 key = bytes.fromhex(tx_hash)
-                if key in replayed_from_blocks:
-                    continue
+                if key in block_txs:
+                    continue  # already delivered via block replay
                 tx = self.mempool.get_tx(key)
                 if tx is None:
                     continue  # tx bytes unavailable (not in mempool WAL)
@@ -150,6 +177,25 @@ class Handshaker:
                 res = proxy_app.consensus.commit_sync()
                 app_hash = res.data
 
+        # the mempool WAL is append-only: its replay re-ingested txs the
+        # chain already carries (fast-committed OR block-committed); left
+        # in, they would be re-proposed into new blocks and double-applied
+        # network-wide. Purge everything already committed by either path.
+        if self.mempool is not None:
+            committed_now = [
+                tx
+                for _, tx in self.mempool.entries()
+                if _hl.sha256(tx).digest() in block_txs
+                or (
+                    self.tx_store is not None
+                    and self.tx_store.has_tx(
+                        _hl.sha256(tx).hexdigest().upper()
+                    )
+                )
+            ]
+            if committed_now:
+                self.mempool.update(state.last_block_height, committed_now)
+
         # NOTE: the reference's app-hash equality check (replay.go:258-266)
         # is deliberately absent: state.app_hash is the deterministic chain
         # digest (state.execution.chain_app_hash), not the live app's hash;
@@ -157,12 +203,17 @@ class Handshaker:
         # agreement is enforced structurally by the deliver sequence above.
         return state
 
-    def _exec_replay_block(self, proxy_app: AppConns, block):
+    def _exec_replay_block(self, proxy_app: AppConns, block, delivered: set):
         """Deliver one stored block to the app, INCLUDING Vtxs (replay-only
-        behavior — see module docstring), then commit. Returns
-        (app_hash, ABCIResponses) where the responses cover block.txs only
-        (matching what the normal exec path records: Vtxs are never part of
-        the results hash)."""
+        behavior — see module docstring), then commit. ``delivered`` dedups
+        across the replay: repeats get a synthesized OK response, exactly
+        like the live path's skipped claims, so the reconstructed results
+        match the original execution. Returns (app_hash, ABCIResponses);
+        responses cover block.txs only (Vtxs are never in the results
+        hash)."""
+        import hashlib as _hl
+
+        from ..abci.types import ResponseDeliverTx
         from ..state.state import ABCIResponses
 
         conn = proxy_app.consensus
@@ -175,6 +226,11 @@ class Handshaker:
         )
         results = []
         for tx in list(block.vtxs) + list(block.txs):
+            key = _hl.sha256(tx).digest()
+            if key in delivered:
+                results.append(ResponseDeliverTx())
+                continue
+            delivered.add(key)
             results.append(conn.deliver_tx_async(tx).value)
         conn.flush()
         end = conn.end_block_sync(RequestEndBlock(height=block.height))

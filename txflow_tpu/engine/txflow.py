@@ -326,18 +326,27 @@ class TxFlow:
 
     def _enqueue_commit(self, vs: TxVoteSet) -> None:
         """Step-side half of a pipelined commit: engine bookkeeping now,
-        side-effects on the committer thread (in decision order)."""
+        side-effects on the committer thread (in decision order). The tx
+        BYTES are captured here — by the time the committer runs, a block
+        carrying this tx as a vtx may have purged the mempool (its claim
+        saw our _committed mark and skipped delivery, counting on us), and
+        a late get_tx(None) would silently drop the apply."""
         self.vote_sets.pop(vs.tx_hash, None)
         self._committed.push(_hash_key(vs.tx_hash))
-        self._commit_q.put((vs, vs.get_votes()))
+        self._commit_q.put((vs, vs.get_votes(), self.mempool.get_tx(vs.tx_key)))
 
     def _commit_effects(
-        self, vs: TxVoteSet, quorum_votes: list[TxVote], purge_batch: list | None
+        self,
+        vs: TxVoteSet,
+        quorum_votes: list[TxVote],
+        purge_batch: list | None,
+        tx: bytes | None = None,
     ) -> None:
         """Store + execute + commitpool effects (reference addVote
         :216-232 sequence); runs on the committer thread when pipelined."""
         self.tx_store.save_tx(vs)
-        tx = self.mempool.get_tx(vs.tx_key)
+        if tx is None:
+            tx = self.mempool.get_tx(vs.tx_key)
         if tx is not None:
             app_hash, _ = self.tx_executor.apply_tx(self.height, tx)
             self.app_hash = app_hash
@@ -372,15 +381,25 @@ class TxFlow:
             if item is None:  # stop() sentinel, queued after last commit
                 flush()
                 return
-            vs, votes = item
+            vs, votes, tx = item
             try:
-                self._commit_effects(vs, votes, purge)
+                self._commit_effects(vs, votes, purge, tx)
             except Exception:
                 import traceback
 
                 traceback.print_exc()
             if len(purge) >= 8192 or self._commit_q.empty():
                 flush()
+
+    def is_tx_committed(self, tx_hash: str) -> bool:
+        """Committed via EITHER path: the fast path (TxStore certificate)
+        or a block that carried it (engine claim mark). A tx reaped into a
+        block before its votes aggregated commits without ever touching
+        the TxStore."""
+        with self._mtx:
+            return self._committed.__contains__(
+                _hash_key(tx_hash)
+            ) or self.tx_store.has_tx(tx_hash)
 
     def is_tx_reserved(self, tx: bytes) -> bool:
         """True if the fast path owns this tx: already committed, queued
@@ -423,6 +442,10 @@ class TxFlow:
                 return False
             vs = self.vote_sets.pop(tx_hash, None)
             self._committed.push(_hash_key(tx_hash))
+            # durable marker: the in-memory LRU can evict, and a tx that
+            # committed only via a block has no TxStore certificate —
+            # is_tx_committed must never regress to False for it
+            self.tx_store.mark_block_committed(tx_hash)
             if vs is not None:
                 # release the set's aggregated votes from the pool — they
                 # are skip-listed by _added_keys and no engine commit will

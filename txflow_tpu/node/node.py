@@ -104,10 +104,22 @@ class Node:
         # -- event bus (node/node.go:585) --
         self.event_bus = EventBus()
 
-        # -- pools (node/node.go:627-633) --
-        self.mempool = Mempool(self.config.mempool, proxy_app_conn=self.proxy_app.mempool)
+        # -- pools (node/node.go:627-633); WALs per node under the config's
+        # wal_dir (reference InitWAL at OnStart, node/node.go:805-808) --
+        wal_dir = self.config.mempool.wal_dir
+        self.mempool = Mempool(
+            self.config.mempool,
+            proxy_app_conn=self.proxy_app.mempool,
+            wal_path=f"{wal_dir}/mempool-{node_id}.wal" if wal_dir else "",
+        )
         self.commitpool = Mempool(self.config.mempool)  # fast-committed txs for blocks
-        self.tx_vote_pool = TxVotePool(self.config.mempool)
+        self.tx_vote_pool = TxVotePool(
+            self.config.mempool,
+            wal_path=f"{wal_dir}/txvotes-{node_id}.wal" if wal_dir else "",
+        )
+        if wal_dir:
+            self.mempool.replay_wal()
+            self.tx_vote_pool.replay_wal()
 
         # -- stores + executors (node/node.go:645-668) --
         self.tx_store = TxStore(tx_store_db if tx_store_db is not None else MemDB())
@@ -279,7 +291,7 @@ class Node:
 
     def is_committed(self, tx: bytes) -> bool:
         tx_hash = hashlib.sha256(tx).hexdigest().upper()
-        return self.tx_store.has_tx(tx_hash)
+        return self.txflow.is_tx_committed(tx_hash)
 
     @property
     def committed_height_view(self) -> int:

@@ -124,7 +124,10 @@ def double_scalar_mul(s_nibbles, h_nibbles, base_table, a_tables, axis_name=None
         return acc
 
     init = ext_identity(s_nibbles.shape[:-1])
-    if axis_name is not None and hasattr(jax.lax, "pvary"):
+    if axis_name is not None:
+        # required (no hasattr fallback): the sharded wrappers run with the
+        # VMA checker ON, which needs this variance cast — a JAX without
+        # lax.pvary could not trace them anyway
         init = tuple(jax.lax.pvary(t, axis_name) for t in init)
     return jax.lax.fori_loop(0, NWINDOWS, step, init)
 

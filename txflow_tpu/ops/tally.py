@@ -125,7 +125,7 @@ def compact_step_packed(axis_name: str | None = None):
         valid, total, maj = inner(*args)
         total = total.astype(jnp.int32)
         maj = maj.astype(jnp.int32)
-        if axis_name is not None and hasattr(jax.lax, "pvary"):
+        if axis_name is not None:
             # stake/maj are psum-replicated (device-invariant); concatenating
             # them with the device-varying valid segment needs an explicit
             # variance cast for the VMA checker

@@ -109,7 +109,9 @@ class Mempool(IngestLogPool):
 
     # -- ingest (reference CheckTx/CheckTxWithInfo :220-303) --
 
-    def check_tx(self, tx: bytes, tx_info: TxInfo | None = None) -> None:
+    def check_tx(
+        self, tx: bytes, tx_info: TxInfo | None = None, write_wal: bool = True
+    ) -> None:
         """Raises on rejection; returns None when the tx entered the pool."""
         tx_info = tx_info or TxInfo()
         with self._mtx:
@@ -144,7 +146,7 @@ class Mempool(IngestLogPool):
                 if err is not None:
                     self.cache.remove(key)
                     raise ValueError(f"rejected by post_check: {err}")
-            if self.wal is not None:
+            if self.wal is not None and write_wal:
                 self.wal.write(tx)
             entry = _MempoolTx(self.height, gas, tx, {tx_info.sender_id})
             self._txs[key] = entry
@@ -255,6 +257,24 @@ class Mempool(IngestLogPool):
             self._log.clear()
             self._txs_bytes = 0
             self.cache.reset()
+
+    def init_wal(self, path: str) -> None:
+        self.wal = WAL(path)
+
+    def replay_wal(self) -> int:
+        """Re-ingest txs from the WAL (crash recovery; reference mempool
+        InitWAL semantics). Committed txs are filtered out afterwards by
+        the caller (Handshaker/engine know what committed); returns count."""
+        if self.wal is None:
+            return 0
+        n = 0
+        for tx in self.wal.replay():
+            try:
+                self.check_tx(tx, write_wal=False)
+                n += 1
+            except Exception:
+                continue  # dup/full/app-rejected: same as live ingest
+        return n
 
     def close_wal(self) -> None:
         if self.wal is not None:

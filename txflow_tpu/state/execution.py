@@ -253,10 +253,15 @@ class BlockExecutor:
             # (= applied) a second time after the block already carried it
             if block.vtxs:
                 self.mempool.update(block.height, block.vtxs)
-            # defect fix: purge included Vtxs so they are not re-proposed
+            # commitpool: purge included Vtxs so they are not re-proposed
+            # (reference defect fixed) AND cache-mark the block's Txs so a
+            # racing fast-path commit cannot push a tx the chain already
+            # carries back in as a later block's vtx
             self.commitpool.lock()
             try:
-                self.commitpool.update(block.height, block.vtxs)
+                self.commitpool.update(
+                    block.height, list(block.txs) + list(block.vtxs)
+                )
             finally:
                 self.commitpool.unlock()
             return commit_res.data

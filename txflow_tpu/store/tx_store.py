@@ -134,8 +134,17 @@ class TxStore:
         votes = _decode_votes(raw)
         return Commit(tx_hash, [CommitSig.from_vote(v) for v in votes])
 
+    def mark_block_committed(self, tx_hash: str) -> None:
+        """Durable marker for a tx committed VIA A BLOCK (no fast-path
+        certificate exists): keeps has_tx/is_committed stable across LRU
+        churn and restarts. Not part of the fast-path commit-order log —
+        block replay covers these txs."""
+        self.db.set(b"B:" + tx_hash.encode(), b"1")
+
     def has_tx(self, tx_hash: str) -> bool:
-        return self.db.has(_tx_key(tx_hash))
+        return self.db.has(_tx_key(tx_hash)) or self.db.has(
+            b"B:" + tx_hash.encode()
+        )
 
     def committed_hashes_in_order(self) -> list[str]:
         """Tx hashes in fast-path commit order (crash-recovery replay)."""

@@ -19,6 +19,7 @@ class FailpointError(RuntimeError):
 
 _mtx = threading.Lock()
 _armed: dict[str, int] = {}
+_fired: set[str] = set()
 
 
 def _load_env() -> None:
@@ -42,8 +43,10 @@ def disarm(name: str | None = None) -> None:
     with _mtx:
         if name is None:
             _armed.clear()
+            _fired.clear()
         else:
             _armed.pop(name, None)
+            _fired.discard(name)
 
 
 def fail(name: str) -> None:
@@ -53,5 +56,15 @@ def fail(name: str) -> None:
         if _armed[name] > 0:
             _armed[name] -= 1
             return
-        del _armed[name]
+        # STICKY once fired: a real crash kills the process, so retries of
+        # the same code path (serialized consensus loops catch exceptions
+        # and continue) must keep failing until the test disarms — else
+        # the "crashed" operation quietly completes on the next pass and
+        # the crash window closes itself
+        _fired.add(name)
     raise FailpointError(f"failpoint {name} fired")
+
+
+def fired(name: str) -> bool:
+    with _mtx:
+        return name in _fired
