@@ -75,6 +75,8 @@ class ConsensusState:
         # atomic "has the fast path applied this vtx" claim (see
         # _vtx_filter); the composition root wires the engine's claim_vtx
         self.vtx_claimer = None
+        # equivocation capture (node wires the evidence pool)
+        self.on_evidence = None
         self.priv_val = priv_val
         self.event_bus = event_bus
         self.on_commit = on_commit
@@ -676,6 +678,36 @@ class ConsensusState:
             return
         added, err = rs.votes.add_vote(vote, peer_id)
         if not added:
+            from ..types.block_vote import ErrConflictingBlockVote
+
+            if isinstance(err, ErrConflictingBlockVote):
+                # equivocation: same validator, same (h, r, type),
+                # different block — capture instead of just dropping.
+                # The NEW vote's signature is verified FIRST: the conflict
+                # check fires before signature verification, so without
+                # this gate a peer could spam forged conflicts and make
+                # every one cost the evidence pool two ed25519 verifies
+                vset = (
+                    rs.votes.prevotes(vote.round)
+                    if vote.type == PREVOTE
+                    else rs.votes.precommits(vote.round)
+                )
+                existing = vset.get_by_address(vote.validator_address)
+                if existing is not None and self.on_evidence is not None:
+                    _, val = rs.validators.get_by_address(vote.validator_address)
+                    if val is not None and vote.verify(
+                        self.state.chain_id, val.pub_key
+                    ):
+                        from ..types.evidence import DuplicateBlockVoteEvidence
+
+                        try:
+                            self.on_evidence(
+                                DuplicateBlockVoteEvidence(
+                                    existing.copy(), vote.copy()
+                                )
+                            )
+                        except Exception:
+                            pass
             return
         if vote.type == PREVOTE:
             prevotes = rs.votes.prevotes(vote.round)

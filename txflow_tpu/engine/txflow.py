@@ -191,8 +191,10 @@ class TxFlow:
                 vs = self.vote_sets.get(vote.tx_hash)
                 if vs is not None and vs.get_by_address(vote.validator_address) is not None:
                     # the set already holds a vote from this validator:
-                    # identical signature = silent dup, different = conflict
-                    # (rejected) — either way it can never be added
+                    # identical signature = silent dup, different = an
+                    # honest re-sign (timestamped sign bytes — NOT
+                    # equivocation, types/evidence.py docstring); both are
+                    # dropped first-signature-wins like the reference
                     drop_now.append(key)
                     continue
                 if (

@@ -42,6 +42,7 @@ class LocalNet:
         ticker_factory=None,
         wal_dir: str = "",
         verifier=None,
+        rpc: bool = False,  # True: each node serves HTTP RPC on an ephemeral port
     ):
         self.chain_id = chain_id
         if priv_vals is None:
@@ -78,6 +79,7 @@ class LocalNet:
                     # (pregenerated-vote replay, BASELINE config 1); the
                     # node keeps its consensus identity either way
                     sign_votes=sign,
+                    rpc_port=0 if rpc else None,
                     ticker_factory=ticker_factory,
                     consensus_wal_path=(
                         f"{wal_dir}/node{i}-consensus.wal" if wal_dir else ""

@@ -57,6 +57,10 @@ class NodeConfig:
     enable_consensus: bool = True
     consensus_wal_path: str = ""
     ticker_factory: object = None
+    # HTTP RPC + metrics listener (reference startRPC, node/node.go:878-
+    # 1007); port 0 = ephemeral (read Node.rpc.addr), None = no listener
+    rpc_port: int | None = None
+    rpc_host: str = "127.0.0.1"
 
 
 class Node:
@@ -182,6 +186,18 @@ class Node:
         self.switch.add_reactor("mempool", self.mempool_reactor)
         self.switch.add_reactor("txvote", self.txvote_reactor)
 
+        # -- evidence pool + reactor (node/node.go:354-367; channel 0x38) --
+        from ..pool.evidence import EvidencePool
+        from ..reactors.evidence_reactor import EvidenceReactor
+
+        self.evidence_pool = EvidencePool(
+            chain_id,
+            lambda: self.state_view().validators,
+            event_bus=self.event_bus,
+        )
+        self.evidence_reactor = EvidenceReactor(self.evidence_pool)
+        self.switch.add_reactor("evidence", self.evidence_reactor)
+
         # -- block path: stores + executor + consensus (node/node.go:636-680) --
         self.block_store = BlockStore(block_db if block_db is not None else MemDB())
         self.block_executor = BlockExecutor(
@@ -209,9 +225,17 @@ class Node:
                 on_commit=self._on_block_commit,
             )
             self.consensus.vtx_claimer = self.txflow.claim_vtx
+            self.consensus.on_evidence = lambda ev: self.evidence_pool.add(ev)
             self.block_executor.tx_reserved = self.txflow.is_tx_reserved
             self.consensus_reactor = ConsensusReactor(self.consensus)
             self.switch.add_reactor("consensus", self.consensus_reactor)
+
+        # -- RPC + metrics listener (node/node.go:878-1007) --
+        self.rpc = None
+        if nc.rpc_port is not None:
+            from ..rpc import RPCServer
+
+            self.rpc = RPCServer(self, host=nc.rpc_host, port=nc.rpc_port)
 
         self._started = False
 
@@ -230,6 +254,7 @@ class Node:
         self.txflow.update_state(height, val_set or self._val_set)
         self.txvote_reactor.broadcast_height(height)
         self.mempool_reactor.broadcast_height(height)
+        self.evidence_pool.prune(height)
 
     def _on_block_commit(self, new_state, block=None) -> None:
         """Consensus commit hook: sync the fast path to the new height and
@@ -271,11 +296,15 @@ class Node:
         self.txflow.start()
         if self.consensus is not None:
             self.consensus.start()
+        if self.rpc is not None:
+            self.rpc.start()
 
     def stop(self) -> None:
         if not self._started:
             return
         self._started = False
+        if self.rpc is not None:
+            self.rpc.stop()
         if self.consensus is not None:
             self.consensus.stop()
         self.txflow.stop()

@@ -1,0 +1,88 @@
+"""EvidencePool: verified, deduped equivocation evidence awaiting
+operator action / gossip (the slot the reference fills with tendermint's
+upstream evidence pool + reactor, node/node.go:354-367 — here rebuilt for
+both the block path AND the fast path's conflicting TxVotes).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..types.validator import ValidatorSet
+from ..utils.events import EventBus, EventEvidence
+
+# evidence older than this many heights below the current one is pruned
+# (upstream ConsensusParams.Evidence.MaxAge analog)
+MAX_AGE_HEIGHTS = 100000
+
+
+class EvidencePool:
+    def __init__(
+        self,
+        chain_id: str,
+        val_set_provider,  # () -> ValidatorSet for verification
+        event_bus: EventBus | None = None,
+    ):
+        self.chain_id = chain_id
+        self._val_set_provider = val_set_provider
+        self.event_bus = event_bus
+        self._mtx = threading.Lock()
+        self._pending: dict[bytes, object] = {}  # hash -> evidence
+        self._committed: set[bytes] = set()
+        self.on_add = lambda ev: None  # reactor hook: gossip new evidence
+
+    def add(self, ev) -> tuple[bool, str | None]:
+        """Verify + admit one piece of evidence; returns (added, err)."""
+        h = ev.hash()
+        with self._mtx:
+            if h in self._pending or h in self._committed:
+                return False, None  # known: not an error
+        val_set: ValidatorSet = self._val_set_provider()
+        _, val = val_set.get_by_address(ev.validator_address)
+        if val is None:
+            return False, "evidence names an unknown validator"
+        err = ev.verify(self.chain_id, val.pub_key)
+        if err is not None:
+            return False, err
+        with self._mtx:
+            if h in self._pending or h in self._committed:
+                return False, None
+            self._pending[h] = ev
+        if self.event_bus is not None:
+            self.event_bus.publish(EventEvidence, ev)
+        try:
+            self.on_add(ev)
+        except Exception:
+            pass
+        return True, None
+
+    def pending(self) -> list:
+        with self._mtx:
+            return list(self._pending.values())
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._pending)
+
+    def has(self, ev) -> bool:
+        h = ev.hash()
+        with self._mtx:
+            return h in self._pending or h in self._committed
+
+    def mark_committed(self, evs: list) -> None:
+        """Evidence landed on-chain (or was otherwise handled): stop
+        gossiping it but remember it so it cannot be re-admitted."""
+        with self._mtx:
+            for ev in evs:
+                h = ev.hash()
+                self._pending.pop(h, None)
+                self._committed.add(h)
+
+    def prune(self, current_height: int) -> int:
+        """Drop pending evidence older than MAX_AGE_HEIGHTS."""
+        cutoff = current_height - MAX_AGE_HEIGHTS
+        with self._mtx:
+            stale = [h for h, ev in self._pending.items() if ev.height() < cutoff]
+            for h in stale:
+                del self._pending[h]
+            return len(stale)

@@ -1,0 +1,13 @@
+"""Key management: file signer with last-sign-state + remote signer socket
+(reference privval/ package)."""
+
+from .file import ErrDoubleSign, FilePV
+from .signer import RemoteSignerError, SignerClient, SignerServer
+
+__all__ = [
+    "ErrDoubleSign",
+    "FilePV",
+    "RemoteSignerError",
+    "SignerClient",
+    "SignerServer",
+]

@@ -1,0 +1,78 @@
+"""EvidenceReactor: gossip equivocation evidence (the reference wires
+tendermint's evidence reactor on channel 0x38, node/node.go:354-367).
+
+Push-on-add plus a periodic re-offer of pending evidence to every peer
+(evidence must eventually reach everyone even across joins/partitions;
+receivers verify + dedup, so re-offers are idempotent).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..p2p.base import ChannelDescriptor, Reactor
+from ..types.evidence import decode_evidence, encode_evidence
+
+CHANNEL_EVIDENCE = 0x38  # reference channel id
+
+_REOFFER_INTERVAL = 1.0
+
+
+class EvidenceReactor(Reactor):
+    def __init__(self, pool):
+        super().__init__("evidence")
+        self.pool = pool
+        pool.on_add = self._broadcast
+        self._stop = threading.Event()
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(id=CHANNEL_EVIDENCE, priority=4)]
+
+    def on_start(self) -> None:
+        self._stop.clear()
+        threading.Thread(
+            target=self._reoffer_loop, name="evidence-gossip", daemon=True
+        ).start()
+
+    def on_stop(self) -> None:
+        self._stop.set()
+
+    def add_peer(self, peer) -> None:
+        self._offer(peer, self.pool.pending())
+
+    def receive(self, chan_id: int, peer, msg: bytes) -> None:
+        ev = decode_evidence(msg)  # decode error stops the peer (switch)
+        # a semantic add error (e.g. the named validator rotated out of
+        # OUR current set, or our view lags the sender's) is NOT peer
+        # misbehavior — dropping the peer for it would sever honest links
+        self.pool.add(ev)
+
+    def _broadcast(self, ev) -> None:
+        if self.switch is not None:
+            for peer in self.switch.peers():
+                self._offer(peer, [ev])
+
+    def _offer(self, peer, evs) -> None:
+        """Send each piece of evidence AT MOST ONCE per connection: the
+        periodic loop exists to cover joins/races, not to rebroadcast the
+        same frames forever."""
+        sent: set = peer.get("evidence_sent")  # type: ignore[assignment]
+        if sent is None:
+            sent = set()
+            peer.set("evidence_sent", sent)
+        for ev in evs:
+            h = ev.hash()
+            if h in sent:
+                continue
+            if peer.try_send(CHANNEL_EVIDENCE, encode_evidence(ev)):
+                sent.add(h)
+
+    def _reoffer_loop(self) -> None:
+        while not self._stop.wait(_REOFFER_INTERVAL):
+            if self.switch is None:
+                continue
+            pending = self.pool.pending()
+            if not pending:
+                continue
+            for peer in self.switch.peers():
+                self._offer(peer, pending)

@@ -1,0 +1,5 @@
+"""Operator/client RPC surface (reference rpc core subset + Prometheus)."""
+
+from .server import RPCServer
+
+__all__ = ["RPCServer"]

@@ -1,0 +1,238 @@
+"""RPC server: the operator/client HTTP surface (reference node/node.go:
+878-1007 — RPC listeners + the Prometheus metrics server).
+
+Minimal JSON-over-HTTP core mirroring the tendermint RPC methods the
+reference exposes for the fast path, plus the Prometheus text exposition:
+
+- GET/POST /broadcast_tx?tx=0x.. | ?tx="str"   -> submit a tx (CheckTx)
+- GET  /status                                  -> node/chain/height info
+- GET  /tx?hash=HEX                             -> committed-tx lookup
+      (fast-path certificate: votes + commit presence)
+- GET  /subscribe_tx?hash=HEX&timeout=SECS      -> long-poll until the tx
+      commits (the WS tx-subscription analog; resolves on EITHER path)
+- GET  /block?height=N                          -> block + hashes
+- GET  /blockchain                              -> store height + base
+- GET  /validators                              -> current validator set
+- GET  /abci_query?path=P&data=0x..             -> app query
+- GET  /metrics                                 -> Prometheus exposition
+- GET  /health                                  -> {}
+
+Served by a stdlib ThreadingHTTPServer — the runtime dependency story
+stays 'none'; handlers only touch thread-safe node surfaces.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _parse_tx_param(raw: str) -> bytes:
+    """tendermint-style tx param: 0x-hex or a (possibly quoted) string."""
+    if raw.startswith("0x") or raw.startswith("0X"):
+        return bytes.fromhex(raw[2:])
+    if len(raw) >= 2 and raw[0] == raw[-1] == '"':
+        raw = raw[1:-1]
+    return raw.encode()
+
+
+class RPCServer:
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+        self.node = node
+        rpc = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+            def _reply(self, obj, code=200):
+                payload = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _reply_text(self, text: str, code=200):
+                payload = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_POST(self):
+                # drain the body BEFORE dispatch: with keep-alive enabled,
+                # unread body bytes would be parsed as the next request
+                # line on this connection
+                try:
+                    n = int(self.headers.get("Content-Length", "0") or "0")
+                    if n > 0:
+                        self.rfile.read(min(n, 1 << 20))
+                except (ValueError, OSError):
+                    pass
+                self.do_GET()
+
+            def do_GET(self):
+                try:
+                    parsed = urllib.parse.urlparse(self.path)
+                    q = {
+                        k: v[0]
+                        for k, v in urllib.parse.parse_qs(parsed.query).items()
+                    }
+                    route = parsed.path.rstrip("/") or "/"
+                    handler = rpc._routes.get(route)
+                    if handler is None:
+                        self._reply({"error": f"unknown path {route}"}, 404)
+                        return
+                    result = handler(q)
+                    if route == "/metrics":
+                        self._reply_text(result)
+                    else:
+                        self._reply({"result": result})
+                except Exception as e:
+                    self._reply({"error": repr(e)}, 500)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.addr = self._httpd.server_address
+        self._thread: threading.Thread | None = None
+        self._routes = {
+            "/broadcast_tx": self._broadcast_tx,
+            "/broadcast_tx_sync": self._broadcast_tx,
+            "/status": self._status,
+            "/tx": self._tx,
+            "/subscribe_tx": self._subscribe_tx,
+            "/block": self._block,
+            "/blockchain": self._blockchain,
+            "/validators": self._validators,
+            "/abci_query": self._abci_query,
+            "/metrics": self._metrics,
+            "/health": lambda q: {},
+        }
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="rpc-http", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- handlers --
+
+    def _broadcast_tx(self, q: dict) -> dict:
+        tx = _parse_tx_param(q["tx"])
+        self.node.broadcast_tx(tx)
+        return {"hash": hashlib.sha256(tx).hexdigest().upper(), "code": 0}
+
+    def _status(self, q: dict) -> dict:
+        node = self.node
+        from .. import version
+
+        return {
+            "node_info": {
+                "id": node.node_id,
+                "network": node.chain_id,
+                "protocol_version": {
+                    "p2p": version.P2P_PROTOCOL,
+                    "block": version.BLOCK_PROTOCOL,
+                    "app": version.ABCI_SEMVER,
+                },
+                "version": version.SEMVER,
+            },
+            "sync_info": {
+                "latest_block_height": node.block_store.height(),
+                "latest_app_hash": node.chain_state.app_hash.hex(),
+                "fast_path_height": node.committed_height_view,
+            },
+            "validator_info": {
+                "address": (
+                    node.priv_val.get_address().hex().upper()
+                    if node.priv_val
+                    else ""
+                ),
+            },
+        }
+
+    def _tx(self, q: dict) -> dict:
+        tx_hash = q["hash"].upper()
+        votes = self.node.tx_store.load_tx_votes(tx_hash)
+        commit = self.node.tx_store.load_tx_commit(tx_hash)
+        committed = self.node.txflow.is_tx_committed(tx_hash)
+        return {
+            "hash": tx_hash,
+            "committed": committed,
+            "votes": len(votes) if votes else 0,
+            "has_commit_cert": commit is not None,
+        }
+
+    def _subscribe_tx(self, q: dict) -> dict:
+        """Long-poll tx-commit subscription (the WS subscribe analog:
+        reference EventDataTx over the event bus, node/node.go:914-922)."""
+        tx_hash = q["hash"].upper()
+        timeout = min(float(q.get("timeout", "25")), 60.0)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.node.txflow.is_tx_committed(tx_hash):
+                return {"hash": tx_hash, "committed": True}
+            time.sleep(0.02)
+        return {"hash": tx_hash, "committed": False, "timeout": True}
+
+    def _block(self, q: dict) -> dict:
+        height = int(q["height"])
+        block = self.node.block_store.load_block(height)
+        if block is None:
+            raise ValueError(f"no block at height {height}")
+        return {
+            "height": block.height,
+            "hash": block.hash().hex().upper(),
+            "num_txs": len(block.txs),
+            "num_vtxs": len(block.vtxs),
+            "txs": [tx.hex() for tx in block.txs],
+            "vtxs": [tx.hex() for tx in block.vtxs],
+            "app_hash": block.header.app_hash.hex(),
+            "proposer": block.header.proposer_address.hex().upper(),
+        }
+
+    def _blockchain(self, q: dict) -> dict:
+        store = self.node.block_store
+        return {"base": store.base(), "height": store.height()}
+
+    def _validators(self, q: dict) -> dict:
+        vs = self.node.chain_state.validators
+        return {
+            "count": len(vs),
+            "total_power": vs.total_voting_power(),
+            "validators": [
+                {
+                    "address": v.address.hex().upper(),
+                    "pub_key": v.pub_key.hex(),
+                    "power": v.voting_power,
+                }
+                for v in vs
+            ],
+        }
+
+    def _abci_query(self, q: dict) -> dict:
+        data = q.get("data", "")
+        raw = bytes.fromhex(data[2:]) if data.startswith("0x") else data.encode()
+        res = self.node.proxy_app.query.query_sync(q.get("path", ""), raw)
+        return {
+            "code": res.code,
+            "key": (res.key or b"").hex(),
+            "value": (res.value or b"").hex(),
+            "height": res.height,
+        }
+
+    def _metrics(self, q: dict) -> str:
+        return self.node.metrics_registry.expose()

@@ -24,6 +24,7 @@ EventNewRoundStep = "NewRoundStep"
 EventCompleteProposal = "CompleteProposal"
 EventVote = "Vote"
 EventValidatorSetUpdates = "ValidatorSetUpdates"
+EventEvidence = "Evidence"  # equivocation captured (types/evidence.py)
 
 
 @dataclass

@@ -1,0 +1,19 @@
+"""Version + protocol identifiers (reference version/version.go:17-46).
+
+The semver tracks THIS framework; the protocol numbers are what cross-host
+handshakes and block headers key compatibility on (the reference pins
+P2PProtocol=7 / BlockProtocol=10 inherited from tendermint v0.31; this
+framework's wire formats are its own, so its protocol numbers start at 1).
+"""
+
+# framework release version
+SEMVER = "0.3.0"
+
+# ABCI-compatible app interface revision (reference ABCISemVer "0.16.0")
+ABCI_SEMVER = "0.16.0"
+
+# p2p wire protocol: frame format + channel ids + handshake
+P2P_PROTOCOL = 1
+
+# block protocol: header/encode format + chain app-hash rule
+BLOCK_PROTOCOL = 1
