@@ -96,3 +96,44 @@ def test_rpc_end_to_end_client_flow():
             assert e.code == 404
     finally:
         net.stop()
+
+
+def test_tx_indexer_and_debug_endpoints():
+    """Indexer queries by height and tag, plus the profiling hooks
+    (reference indexer service node/node.go:211-238, pprof :724-728)."""
+    cfg = make_test_config()
+    cfg.consensus.skip_timeout_commit = True
+    net = LocalNet(
+        4, use_device_verifier=False, enable_consensus=True, config=cfg, rpc=True
+    )
+    net.start()
+    try:
+        addr = net.nodes[0].rpc.addr
+        res = rpc_get(addr, '/broadcast_tx?tx="idx-k=v"')["result"]
+        sub = rpc_get(addr, f"/subscribe_tx?hash={res['hash']}&timeout=30")["result"]
+        assert sub["committed"]
+
+        # indexed record by hash via the indexer (kvstore tags app.key);
+        # the commit EVENT fires on the committer thread just after the
+        # store row the subscription watches, so allow it a moment
+        idx = net.nodes[0].tx_indexer
+        deadline = time.monotonic() + 10
+        rec = None
+        while time.monotonic() < deadline and rec is None:
+            rec = idx.get(res["hash"])
+            time.sleep(0.02)
+        assert rec is not None and rec["hash"] == res["hash"]
+        # tag search through RPC
+        found = rpc_get(addr, "/tx_search?key=app.key&value=idx-k=v")["result"]
+        assert found["total"] >= 1
+        assert any(t["hash"] == res["hash"] for t in found["txs"])
+        # height search returns it once it's known at its indexed height
+        by_h = rpc_get(addr, f"/tx_search?height={rec['height']}")["result"]
+        assert any(t["hash"] == res["hash"] for t in by_h["txs"])
+
+        # thread stack dump (pprof-goroutine analog)
+        stacks = rpc_get(addr, "/debug/stacks")["result"]
+        assert stacks["count"] >= 3
+        assert any("consensus" in name for name in stacks["threads"])
+    finally:
+        net.stop()

@@ -1,0 +1,184 @@
+"""Authenticated-transport tests: X25519 agreement, secret-connection
+handshake with verified ed25519 identities, tamper rejection, and full
+vote gossip between nodes over authenticated TCP (the upstream secret-
+connection slot the reference relies on for every socket).
+"""
+
+import conftest  # noqa: F401
+
+import hashlib
+import socket
+import struct
+import threading
+import time
+
+from txflow_tpu.crypto import ed25519, x25519
+from txflow_tpu.crypto.hash import address_hash
+from txflow_tpu.node.node import Node, NodeConfig
+from txflow_tpu.p2p.secret import SecretConnection
+from txflow_tpu.p2p.transport import ConnectionClosed, tcp_connect_raw, tcp_listen
+from txflow_tpu.types.priv_validator import MockPV
+from txflow_tpu.types.validator import Validator, ValidatorSet
+from txflow_tpu.utils.config import test_config as make_test_config
+
+CHAIN_ID = "test-secret"
+
+
+def test_x25519_rfc7748_vector():
+    # RFC 7748 §5.2 test vector 1
+    k = bytes.fromhex(
+        "a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4"
+    )
+    u = bytes.fromhex(
+        "e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c"
+    )
+    want = bytes.fromhex(
+        "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552"
+    )
+    assert x25519.scalar_mult(k, u) == want
+    # DH property
+    a, b = x25519.generate_private(), x25519.generate_private()
+    assert x25519.shared_secret(a, x25519.public_key(b)) == x25519.shared_secret(
+        b, x25519.public_key(a)
+    )
+
+
+def _pair(seed_a, seed_b):
+    srv = tcp_listen("127.0.0.1", 0)
+    host, port = srv.getsockname()
+    out = {}
+
+    def acceptor():
+        s, _ = srv.accept()
+        try:
+            out["b"] = SecretConnection(s, seed_b)
+        except Exception as e:
+            out["b_err"] = e
+
+    t = threading.Thread(target=acceptor, daemon=True)
+    t.start()
+    a = SecretConnection(tcp_connect_raw(host, port), seed_a)
+    t.join(timeout=10)
+    srv.close()
+    return a, out.get("b"), out.get("b_err")
+
+
+def test_secret_connection_handshake_and_identity():
+    seed_a = hashlib.sha256(b"node-a").digest()
+    seed_b = hashlib.sha256(b"node-b").digest()
+    a, b, err = _pair(seed_a, seed_b)
+    assert err is None
+    # each side learned the VERIFIED identity of the other
+    assert a.peer_pub_key == ed25519.public_key_from_seed(seed_b)
+    assert b.peer_pub_key == ed25519.public_key_from_seed(seed_a)
+    assert a.peer_id == address_hash(ed25519.public_key_from_seed(seed_b)).hex().upper()
+
+    # bidirectional encrypted frames
+    a.send(0x30, b"hello" * 100)
+    chan, msg = b.recv(timeout=5)
+    assert (chan, msg) == (0x30, b"hello" * 100)
+    b.send(0x32, b"world")
+    assert a.recv(timeout=5) == (0x32, b"world")
+    a.close()
+    b.close()
+
+
+def test_secret_connection_rejects_tampered_frames():
+    seed_a = hashlib.sha256(b"tamper-a").digest()
+    seed_b = hashlib.sha256(b"tamper-b").digest()
+    # man-in-the-middle relay that flips one ciphertext bit
+    srv = tcp_listen("127.0.0.1", 0)
+    host, port = srv.getsockname()
+    out = {}
+
+    def acceptor():
+        s, _ = srv.accept()
+        out["b"] = SecretConnection(s, seed_b)
+        try:
+            out["got"] = out["b"].recv(timeout=5)
+        except ConnectionClosed:
+            out["rejected"] = True
+
+    t = threading.Thread(target=acceptor, daemon=True)
+    t.start()
+    a = SecretConnection(tcp_connect_raw(host, port), seed_a)
+    t_start = time.monotonic()
+    while "b" not in out and time.monotonic() - t_start < 5:
+        time.sleep(0.01)
+    # craft a frame, then corrupt it on the wire: send through the raw
+    # socket with a flipped bit in the ciphertext
+    ct = a._send_aead.encrypt(a._nonce(a._send_ctr), bytes([0x30]) + b"payload", b"")
+    a._send_ctr += 1
+    bad = bytearray(ct)
+    bad[5] ^= 0x01
+    a._sock.sendall(struct.pack("!I", len(bad)) + bytes(bad))
+    t.join(timeout=10)
+    assert out.get("rejected"), "tampered frame must close the connection"
+    a.close()
+    out["b"].close()
+
+
+def test_vote_gossip_over_authenticated_tcp():
+    """Two nodes with ed25519 node keys: the switch uses secret
+    connections; peer ids are the verified key addresses; txs commit."""
+    pvs = [MockPV(hashlib.sha256(b"sec-%d" % i).digest()) for i in range(2)]
+    vs = ValidatorSet([Validator.from_pub_key(pv.get_pub_key(), 10) for pv in pvs])
+    by_addr = {pv.get_address(): pv for pv in pvs}
+    pvs_sorted = [by_addr[v.address] for v in vs]
+    node_seeds = [hashlib.sha256(b"nodekey-%d" % i).digest() for i in range(2)]
+
+    def build(i):
+        return Node(
+            node_id=f"sec-node{i}",
+            chain_id=CHAIN_ID,
+            val_set=vs,
+            app=__import__(
+                "txflow_tpu.abci.kvstore", fromlist=["KVStoreApplication"]
+            ).KVStoreApplication(),
+            priv_val=pvs_sorted[i],
+            node_config=NodeConfig(
+                config=make_test_config(),
+                use_device_verifier=False,
+                enable_consensus=False,
+                node_key_seed=node_seeds[i],
+            ),
+        )
+
+    nodes = [build(0), build(1)]
+    for n in nodes:
+        n.start()
+    srv = tcp_listen("127.0.0.1", 0)
+    host, port = srv.getsockname()
+    acc = {}
+
+    def acceptor():
+        s, _ = srv.accept()
+        acc["peer"] = nodes[0].switch.accept_tcp(s)
+
+    t = threading.Thread(target=acceptor, daemon=True)
+    t.start()
+    peer0 = nodes[1].switch.dial_tcp(host, port)
+    t.join(timeout=10)
+
+    # peer ids are derived from the VERIFIED node pubkeys
+    assert peer0.node_id == address_hash(
+        ed25519.public_key_from_seed(node_seeds[0])
+    ).hex().upper()
+    assert acc["peer"].node_id == address_hash(
+        ed25519.public_key_from_seed(node_seeds[1])
+    ).hex().upper()
+
+    try:
+        txs = [b"sec-%d=v" % i for i in range(3)]
+        for tx in txs:
+            nodes[0].broadcast_tx(tx)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(n.is_committed(tx) for n in nodes for tx in txs):
+                break
+            time.sleep(0.02)
+        assert all(n.is_committed(tx) for n in nodes for tx in txs)
+    finally:
+        for n in nodes:
+            n.stop()
+        srv.close()

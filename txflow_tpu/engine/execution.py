@@ -88,5 +88,6 @@ class TxExecutor:
                 result_code=deliver_res.code,
                 result_data=deliver_res.data,
                 result_log=deliver_res.log,
+                tags=list(getattr(deliver_res, "tags", []) or []),
             ),
         )

@@ -61,6 +61,9 @@ class NodeConfig:
     # 1007); port 0 = ephemeral (read Node.rpc.addr), None = no listener
     rpc_port: int | None = None
     rpc_host: str = "127.0.0.1"
+    # ed25519 node key seed: enables authenticated secret connections on
+    # TCP links (reference p2p.LoadOrGenNodeKey, node/node.go:72)
+    node_key_seed: bytes | None = None
 
 
 class Node:
@@ -105,8 +108,12 @@ class Node:
         self.app = app
         self.proxy_app = AppConns(app)
 
-        # -- event bus (node/node.go:585) --
+        # -- event bus + tx indexer service (node/node.go:585, :211-238) --
         self.event_bus = EventBus()
+        from ..services.indexer import TxIndexer
+
+        self.tx_indexer = TxIndexer(MemDB())
+        self.tx_indexer.subscribe(self.event_bus)
 
         # -- pools (node/node.go:627-633); WALs per node under the config's
         # wal_dir (reference InitWAL at OnStart, node/node.go:805-808) --
@@ -159,7 +166,7 @@ class Node:
         )
 
         # -- switch + reactors (node/node.go:688-722; wiring bug fixed) --
-        self.switch = Switch(node_id)
+        self.switch = Switch(node_id, node_seed=nc.node_key_seed)
         mp_bcast = (
             nc.mempool_broadcast
             if nc.mempool_broadcast is not None

@@ -1,0 +1,202 @@
+"""SecretConnection: authenticated, encrypted peer links (the upstream
+tendermint secret-connection slot the reference rides for every p2p
+socket — Station-to-Station over X25519 + ed25519 identity signatures,
+ChaCha20-Poly1305 frames).
+
+Handshake (both directions symmetric):
+1. exchange 32-byte ephemeral X25519 public keys in the clear;
+2. shared = X25519(eph_priv, peer_eph_pub); role = lexicographic order
+   of the two ephemeral pubkeys (lo/hi, like upstream);
+3. HKDF-SHA256(shared, transcript=lo||hi) -> (key_lo->hi, key_hi->lo,
+   challenge);
+4. each side sends, ENCRYPTED, its ed25519 node pubkey + signature over
+   the challenge; the peer verifies the signature before any payload
+   flows. The authenticated identity is exposed as ``peer_pub_key`` /
+   ``peer_id`` (address hex) — the switch uses it as the node id, so ids
+   cannot be spoofed the way the plaintext string handshake allows.
+
+Frames: u32-be length || ChaCha20-Poly1305(ciphertext of
+``chan_id u8 || payload``), nonce = 12-byte little-endian per-direction
+counter (distinct keys per direction, so counters cannot collide).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import socket
+import struct
+import threading
+
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+from ..crypto import ed25519, x25519
+from ..crypto.hash import address_hash
+from .transport import MAX_FRAME_BYTES, ConnectionClosed
+
+_LEN = struct.Struct("!I")
+
+
+def _hkdf_sha256(ikm: bytes, info: bytes, n: int) -> bytes:
+    """HKDF (RFC 5869) with a fixed zero salt."""
+    prk = hmac_mod.new(b"\x00" * 32, ikm, hashlib.sha256).digest()
+    out, t, i = b"", b"", 1
+    while len(out) < n:
+        t = hmac_mod.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        out += t
+        i += 1
+    return out[:n]
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionClosed()
+        buf += chunk
+    return buf
+
+
+class SecretConnection:
+    """Same send/recv surface as transport.TCPConnection, authenticated."""
+
+    HANDSHAKE_TIMEOUT = 10.0
+
+    def __init__(self, sock: socket.socket, node_seed: bytes, label: str = ""):
+        self._sock = sock
+        self._wlock = threading.Lock()
+        self._closed = threading.Event()
+        self.label = label
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # the WHOLE handshake is time-bounded: a silent client must not
+        # wedge the caller (the plaintext path bounds its handshake recv;
+        # an unbounded one here is a zero-byte DoS on the accept path)
+        prev_timeout = sock.gettimeout()
+        sock.settimeout(self.HANDSHAKE_TIMEOUT)
+        try:
+            self._handshake(sock, node_seed)
+        except (socket.timeout, TimeoutError):
+            self.close()
+            raise ValueError("secret connection: handshake timeout")
+        except Exception:
+            self.close()
+            raise
+        finally:
+            if not self._closed.is_set():
+                try:
+                    sock.settimeout(prev_timeout)
+                except OSError:
+                    pass
+
+    def _handshake(self, sock: socket.socket, node_seed: bytes) -> None:
+        # 1-2: ephemeral exchange + shared secret
+        eph_priv = x25519.generate_private()
+        eph_pub = x25519.public_key(eph_priv)
+        sock.sendall(eph_pub)
+        peer_eph = _recv_exact(sock, 32)
+        shared = x25519.shared_secret(eph_priv, peer_eph)
+        lo, hi = sorted((eph_pub, peer_eph))
+        we_are_lo = eph_pub == lo
+
+        # 3: key schedule + challenge
+        material = _hkdf_sha256(shared, b"txflow-secret-conn" + lo + hi, 96)
+        key_lo_to_hi, key_hi_to_lo = material[:32], material[32:64]
+        challenge = material[64:]
+        self._send_aead = ChaCha20Poly1305(
+            key_lo_to_hi if we_are_lo else key_hi_to_lo
+        )
+        self._recv_aead = ChaCha20Poly1305(
+            key_hi_to_lo if we_are_lo else key_lo_to_hi
+        )
+        self._send_ctr = 0
+        self._recv_ctr = 0
+
+        # 4: authenticate identities over the encrypted channel
+        node_pub = ed25519.public_key_from_seed(node_seed)
+        sig = ed25519.sign(node_seed, challenge)
+        self._send_frame(0xFF, node_pub + sig)
+        chan, auth = self._recv_frame()
+        if chan != 0xFF or len(auth) != 96:
+            raise ValueError("secret connection: bad auth frame")
+        peer_pub, peer_sig = auth[:32], auth[32:]
+        if not ed25519.verify(peer_pub, challenge, peer_sig):
+            raise ValueError("secret connection: peer identity signature invalid")
+        self.peer_pub_key = peer_pub
+        self.peer_id = address_hash(peer_pub).hex().upper()
+
+    # -- framing (TCPConnection-compatible surface) --
+
+    def _nonce(self, ctr: int) -> bytes:
+        return ctr.to_bytes(12, "little")
+
+    def _send_frame(self, chan_id: int, msg: bytes) -> None:
+        with self._wlock:
+            ct = self._send_aead.encrypt(
+                self._nonce(self._send_ctr), bytes([chan_id]) + msg, b""
+            )
+            self._send_ctr += 1
+            self._sock.sendall(_LEN.pack(len(ct)) + ct)
+
+    def _recv_frame(self, timeout: float | None = None) -> tuple[int, bytes]:
+        prev = self._sock.gettimeout()
+        if timeout is not None:
+            self._sock.settimeout(timeout)
+        try:
+            hdr = _recv_exact(self._sock, _LEN.size)
+            (n,) = _LEN.unpack(hdr)
+            if n > MAX_FRAME_BYTES + 17:
+                raise ConnectionClosed()
+            ct = _recv_exact(self._sock, n)
+            try:
+                pt = self._recv_aead.decrypt(self._nonce(self._recv_ctr), ct, b"")
+            except Exception:
+                # tampered/replayed frame: the link is gone, not retryable
+                raise ConnectionClosed()
+            self._recv_ctr += 1
+            return pt[0], pt[1:]
+        except socket.timeout:
+            self.close()
+            raise ConnectionClosed()
+        except OSError:
+            raise ConnectionClosed()
+        finally:
+            if timeout is not None and not self._closed.is_set():
+                try:
+                    self._sock.settimeout(prev)
+                except OSError:
+                    pass
+
+    def send(self, chan_id: int, msg: bytes, timeout: float | None = 10.0) -> bool:
+        if self._closed.is_set():
+            return False
+        if len(msg) > MAX_FRAME_BYTES:
+            raise ValueError(f"frame too large: {len(msg)}")
+        try:
+            self._send_frame(chan_id, msg)
+            return True
+        except OSError:
+            self.close()
+            return False
+
+    try_send = send
+
+    def recv(self, timeout: float | None = None) -> tuple[int, bytes]:
+        if self._closed.is_set():
+            raise ConnectionClosed()
+        return self._recv_frame(timeout)
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    @property
+    def is_closed(self) -> bool:
+        return self._closed.is_set()

@@ -111,8 +111,14 @@ class SwitchError(Exception):
 
 
 class Switch:
-    def __init__(self, node_id: str):
+    def __init__(self, node_id: str, node_seed: bytes | None = None):
         self.node_id = node_id
+        # ed25519 node key: when set, TCP links use the authenticated
+        # SecretConnection and peer ids are derived from VERIFIED pubkeys
+        # (upstream rides secret connections for every socket,
+        # node/node.go:420-505); None = plaintext string handshake
+        # (in-proc pipes, legacy tests)
+        self._node_seed = node_seed
         self.reactors: dict[str, Reactor] = {}
         self._chan_to_reactor: dict[int, Reactor] = {}
         self._channels: dict[int, ChannelDescriptor] = {}
@@ -153,6 +159,7 @@ class Switch:
                 return
             self._running = False
             peers = list(self._peers.values())
+        self.close_listener()
         for p in peers:
             self.stop_peer(p, reason="switch stopping")
         for r in list(self.reactors.values()):
@@ -180,6 +187,11 @@ class Switch:
         """Attach a live connection as a peer and start its loops."""
         peer = Peer(conn, node_id, outbound, dict(self._channels))
         with self._mtx:
+            if not self._running:
+                # a handshake completing during/after stop() must not
+                # register threads and sockets nothing will ever stop
+                conn.close()
+                raise SwitchError("switch is stopped")
             if node_id in self._peers:
                 conn.close()
                 raise SwitchError(f"duplicate peer {node_id}")
@@ -201,7 +213,17 @@ class Switch:
         return peer
 
     def dial_tcp(self, host: str, port: int) -> Peer:
-        """Outbound TCP connect + node-id handshake."""
+        """Outbound TCP connect. With a node key: authenticated secret
+        connection, peer id = verified pubkey address. Without: legacy
+        plaintext node-id string handshake."""
+        if self._node_seed is not None:
+            from .secret import SecretConnection
+            from .transport import tcp_connect_raw
+
+            conn = SecretConnection(
+                tcp_connect_raw(host, port), self._node_seed, label=f"{host}:{port}"
+            )
+            return self.add_peer_conn(conn, conn.peer_id, outbound=True)
         conn = tcp_connect(host, port)
         conn.send(_HANDSHAKE_CHANNEL, self.node_id.encode())
         chan_id, payload = conn.recv(timeout=5.0)
@@ -211,7 +233,13 @@ class Switch:
         return self.add_peer_conn(conn, payload.decode(), outbound=True)
 
     def accept_tcp(self, sock) -> Peer:
-        """Inbound accept + node-id handshake (call with an accepted socket)."""
+        """Inbound accept (call with an accepted socket); secret-connection
+        authenticated when this switch has a node key."""
+        if self._node_seed is not None:
+            from .secret import SecretConnection
+
+            conn = SecretConnection(sock, self._node_seed)
+            return self.add_peer_conn(conn, conn.peer_id, outbound=False)
         conn = TCPConnection(sock)
         chan_id, payload = conn.recv(timeout=5.0)
         if chan_id != _HANDSHAKE_CHANNEL:
@@ -219,6 +247,50 @@ class Switch:
             raise SwitchError("handshake expected")
         conn.send(_HANDSHAKE_CHANNEL, self.node_id.encode())
         return self.add_peer_conn(conn, payload.decode(), outbound=False)
+
+    def listen_tcp(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        """Start accepting inbound TCP peers (reference transport.Listen,
+        node/node.go:795-800). Returns the bound (host, port)."""
+        from .transport import tcp_listen
+
+        srv = tcp_listen(host, port)
+        self._listener = srv
+        self.listen_addr = srv.getsockname()
+
+        def _handshake_one(sock):
+            try:
+                self.accept_tcp(sock)
+            except Exception:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+        def _accept_loop():
+            while True:
+                try:
+                    sock, _ = srv.accept()
+                except OSError:
+                    return  # listener closed
+                # handshake off the accept thread: one slow/silent client
+                # must not block further accepts (handshakes are also
+                # individually time-bounded in SecretConnection)
+                threading.Thread(
+                    target=_handshake_one, args=(sock,), daemon=True
+                ).start()
+
+        threading.Thread(
+            target=_accept_loop, name=f"p2p-accept-{self.node_id}", daemon=True
+        ).start()
+        return self.listen_addr
+
+    def close_listener(self) -> None:
+        srv = getattr(self, "_listener", None)
+        if srv is not None:
+            try:
+                srv.close()
+            except OSError:
+                pass
 
     def stop_peer(self, peer: Peer, reason: object = None) -> None:
         with self._mtx:

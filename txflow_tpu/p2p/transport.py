@@ -188,7 +188,12 @@ def tcp_listen(host: str, port: int) -> socket.socket:
     return srv
 
 
-def tcp_connect(host: str, port: int, timeout: float = 5.0) -> TCPConnection:
+def tcp_connect_raw(host: str, port: int, timeout: float = 5.0) -> socket.socket:
+    """A connected raw socket (for wrappers like SecretConnection)."""
     sock = socket.create_connection((host, port), timeout=timeout)
     sock.settimeout(None)
-    return TCPConnection(sock, label=f"{host}:{port}")
+    return sock
+
+
+def tcp_connect(host: str, port: int, timeout: float = 5.0) -> TCPConnection:
+    return TCPConnection(tcp_connect_raw(host, port, timeout), label=f"{host}:{port}")

@@ -41,8 +41,13 @@ def _parse_tx_param(raw: str) -> bytes:
 
 
 class RPCServer:
-    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0, debug=None):
+        """debug: expose /debug/* hooks. Default: only on loopback binds —
+        the reference likewise serves pprof only when ProfListenAddress is
+        explicitly configured (node/node.go:724-728); an open profiling/
+        trace-to-arbitrary-dir endpoint must never face a network."""
         self.node = node
+        self.debug = (host in ("127.0.0.1", "::1", "localhost")) if debug is None else debug
         rpc = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -112,9 +117,15 @@ class RPCServer:
             "/blockchain": self._blockchain,
             "/validators": self._validators,
             "/abci_query": self._abci_query,
+            "/tx_search": self._tx_search,
             "/metrics": self._metrics,
             "/health": lambda q: {},
         }
+        if self.debug:
+            # profiling hooks (reference links net/http/pprof and starts a
+            # JAX-profiler-analog on demand, node/node.go:724-728)
+            self._routes["/debug/stacks"] = self._debug_stacks
+            self._routes["/debug/jax_profile"] = self._debug_jax_profile
 
     # -- lifecycle --
 
@@ -233,6 +244,55 @@ class RPCServer:
             "value": (res.value or b"").hex(),
             "height": res.height,
         }
+
+    def _tx_search(self, q: dict) -> dict:
+        """Indexer queries (reference tx indexer service): by height or by
+        tag (?height=N | ?key=app.key&value=hex-or-str)."""
+        idx = self.node.tx_indexer
+        if "height" in q:
+            hashes = idx.by_height(int(q["height"]))
+        elif "key" in q:
+            val = q.get("value", "")
+            vraw = bytes.fromhex(val[2:]) if val.startswith("0x") else val.encode()
+            hashes = idx.search(q["key"].encode(), vraw)
+        else:
+            raise ValueError("tx_search needs ?height= or ?key=&value=")
+        return {"txs": [idx.get(h) for h in hashes], "total": len(hashes)}
+
+    def _debug_stacks(self, q: dict) -> dict:
+        """All-thread stack dump — the pprof-goroutine analog for a Python
+        runtime (reference serves net/http/pprof when ProfListenAddress is
+        set)."""
+        import sys
+        import traceback
+
+        frames = sys._current_frames()
+        stacks = {}
+        for t in threading.enumerate():
+            f = frames.get(t.ident)
+            stacks[t.name] = (
+                traceback.format_stack(f) if f is not None else ["<no frame>"]
+            )
+        return {"threads": stacks, "count": len(stacks)}
+
+    def _debug_jax_profile(self, q: dict) -> dict:
+        """Start/stop a JAX profiler trace (the XLA-level tracing hook):
+        ?action=start&dir=/tmp/trace | ?action=stop."""
+        import jax.profiler
+
+        import os.path
+
+        action = q.get("action", "start")
+        if action == "start":
+            trace_dir = q.get("dir", "/tmp/txflow-jax-trace")
+            # confine trace output: profiling must not become an
+            # arbitrary-path write primitive
+            if not os.path.abspath(trace_dir).startswith("/tmp/"):
+                raise ValueError("trace dir must live under /tmp/")
+            jax.profiler.start_trace(trace_dir)
+            return {"tracing": True, "dir": trace_dir}
+        jax.profiler.stop_trace()
+        return {"tracing": False}
 
     def _metrics(self, q: dict) -> str:
         return self.node.metrics_registry.expose()

@@ -1,0 +1,5 @@
+"""Node-attached services (reference node/node.go:211-238 indexer slot)."""
+
+from .indexer import TxIndexer
+
+__all__ = ["TxIndexer"]

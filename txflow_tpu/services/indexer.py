@@ -1,0 +1,92 @@
+"""Tx indexer service: committed txs queryable by hash, height, and tags
+(the slot the reference fills with tendermint's upstream indexer service,
+node/node.go:211-238 — an event-bus subscriber writing a KV index).
+
+Subscribes to per-tx commit events from BOTH paths (the fast path's
+EventTx fires from TxExecutor, the block path's from BlockExecutor) and
+indexes:
+
+- ``tx:<hash>``            -> JSON record (height, code, tags, path)
+- ``height:<H>:<hash>``    -> presence row (range scans by height)
+- ``tag:<key>=<val>:<hash>`` -> presence row (tag search)
+
+Queries: ``get(hash)``, ``by_height(h)``, ``search(key, value)``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from ..store.db import DB
+from ..utils.events import EventBus, EventTx
+
+
+class TxIndexer:
+    def __init__(self, db: DB):
+        self.db = db
+        self._mtx = threading.Lock()
+
+    # -- write side (event-bus subscriber) --
+
+    def subscribe(self, bus: EventBus) -> None:
+        bus.subscribe_callback(EventTx, self._on_tx)
+
+    def _on_tx(self, event) -> None:
+        data = event.data
+        try:
+            self.index(
+                tx_hash=data.tx_hash,
+                height=data.height,
+                code=data.result_code,
+                tags=getattr(data, "tags", None) or [],
+            )
+        except Exception:
+            pass  # indexing must never break commit event delivery
+
+    def index(
+        self,
+        tx_hash: str,
+        height: int,
+        code: int = 0,
+        tags: list[tuple[bytes, bytes]] | None = None,
+    ) -> None:
+        tags = tags or []
+        record = {
+            "hash": tx_hash,
+            "height": height,
+            "code": code,
+            "tags": [[k.decode("latin1"), v.decode("latin1")] for k, v in tags],
+        }
+        with self._mtx:
+            self.db.set(b"tx:" + tx_hash.encode(), json.dumps(record).encode())
+            self.db.set(b"height:%016d:%s" % (height, tx_hash.encode()), b"1")
+            for k, v in tags:
+                # tag bytes are arbitrary app data: hex-encode them so a
+                # value containing the row delimiters cannot alias other
+                # rows or corrupt the parsed-out hash
+                self.db.set(_tag_row(k, v) + tx_hash.encode(), b"1")
+
+    # -- read side --
+
+    def get(self, tx_hash: str) -> dict | None:
+        raw = self.db.get(b"tx:" + tx_hash.encode())
+        return json.loads(raw) if raw is not None else None
+
+    def by_height(self, height: int) -> list[str]:
+        prefix = b"height:%016d:" % height
+        out = []
+        for k, _ in self.db.iterate(prefix, prefix + b"\xff"):
+            out.append(k[len(prefix):].decode())
+        return out
+
+    def search(self, key: bytes, value: bytes) -> list[str]:
+        prefix = _tag_row(key, value)
+        out = []
+        for k, _ in self.db.iterate(prefix, prefix + b"\xff"):
+            out.append(k[len(prefix):].decode())
+        return out
+
+
+def _tag_row(key: bytes, value: bytes) -> bytes:
+    return b"tag:" + key.hex().encode() + b"=" + value.hex().encode() + b":"

@@ -284,6 +284,7 @@ class BlockExecutor:
                     result_code=res.code,
                     result_data=res.data,
                     result_log=res.log,
+                    tags=list(getattr(res, "tags", []) or []),
                 ),
             )
         if val_updates:

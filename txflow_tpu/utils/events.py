@@ -102,6 +102,7 @@ class EventDataTx:
     result_code: int = 0
     result_data: bytes = b""
     result_log: str = ""
+    tags: list = field(default_factory=list)  # (key, value) byte pairs
 
 
 @dataclass
