@@ -189,6 +189,7 @@ def run_bench(platform: str) -> dict:
         priv_vals=priv_vals,
         verifier=shared_verifier,
         enable_consensus=with_consensus,
+        index_txs=False,  # nothing queries /tx_search during the bench
     )
 
     # -- pregenerate txs + every validator's votes (untimed) --
@@ -281,11 +282,17 @@ def run_bench(platform: str) -> dict:
     votes_per_sec = committed / wall
 
     # phase 2 — LATENCY: a smaller corpus offered at ~60% of measured
-    # capacity, in small chunks, so p50 reflects pipeline service time
+    # capacity, in small chunks, so p50 reflects pipeline service time.
+    # The pacing axis must match the capacity axis: seed_and_replay paces
+    # INJECTED votes (n_txs * n_vals unique votes per run), so capacity is
+    # measured on that same axis from phase 1's wall clock — votes_per_sec
+    # (committed, summed over nodes) is ~n_nodes x larger and would pace
+    # the wrong load (r3 review finding).
+    injected_per_sec = (n_txs * n_vals) / wall
     lat_txs = max(64, min(n_txs // 4, 2048))
     lat_corpus = make_corpus("lat", lat_txs)
     lat_chunk = max(8, min(chunk // 8, 256))
-    _, inject_t = seed_and_replay(*lat_corpus, lat_chunk, 0.6 * votes_per_sec)
+    _, inject_t = seed_and_replay(*lat_corpus, lat_chunk, 0.6 * injected_per_sec)
     p50 = p50_of(inject_t)
 
     result = {

@@ -1,0 +1,160 @@
+"""Native C prep parity vs the pure-Python path (the golden oracle).
+
+The C module reimplements SHA-512 (FIPS 180-4) and the mod-L reduction
+from the spec; these tests pin it bit-for-bit against hashlib and against
+``_prepare_compact_py``, including the adversarial edges: S >= L
+(ScMinimal reject), short/long signatures, off-range validator indices,
+off-curve pubkeys, empty messages, and extreme digests.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from txflow_tpu import native
+from txflow_tpu.crypto import ed25519 as host_ed
+from txflow_tpu.ops import ed25519_batch
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C compiler available"
+)
+
+L = host_ed.L
+
+
+def test_sha512_matches_hashlib():
+    rng = np.random.default_rng(7)
+    # lengths straddling every padding branch: block size 128, the 112-byte
+    # length-fits boundary, multi-block
+    for n in [0, 1, 55, 56, 63, 64, 111, 112, 113, 127, 128, 129, 255, 256, 1000]:
+        data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+        assert native.sha512(data) == hashlib.sha512(data).digest(), n
+
+
+def test_mod_l_reduction_edges():
+    # drive reduce_mod_l through prep_batch with a fixed (R, A, msg) whose
+    # digest we recompute host-side; cover random + structured extremes by
+    # brute-forcing messages until digests hit high/low ranges is not
+    # possible, so instead verify h == int(sha512(R|A|msg)) % L for many
+    # random inputs — every fold path (4-fold worst case) is exercised by
+    # uniform 512-bit digests with overwhelming probability over 200 trials.
+    rng = np.random.default_rng(8)
+    seeds = [rng.integers(0, 256, 32, dtype=np.uint8).tobytes() for _ in range(4)]
+    pubs_list = [host_ed.public_key_from_seed(s) for s in seeds]
+    epoch = ed25519_batch.EpochTables(pubs_list)
+    n = 200
+    msgs, sigs, vidx = [], [], []
+    for i in range(n):
+        m = rng.integers(0, 256, int(rng.integers(0, 120)), dtype=np.uint8).tobytes()
+        vi = int(rng.integers(0, 4))
+        msgs.append(m)
+        sigs.append(host_ed.sign(seeds[vi], m))
+        vidx.append(vi)
+    batch = ed25519_batch._prepare_compact_native(msgs, sigs, np.array(vidx), epoch)
+    assert batch.pre_ok.all()
+    for i in range(n):
+        digest = hashlib.sha512(sigs[i][:32] + pubs_list[vidx[i]] + msgs[i]).digest()
+        want = int.from_bytes(digest, "little") % L
+        # reconstruct h from MSB-first nibbles
+        got = 0
+        for nib in batch.h_nibbles[i]:
+            got = (got << 4) | int(nib)
+        assert got == want, i
+
+
+def _mk_epoch(n_vals=4):
+    seeds = [hashlib.sha256(b"npv%d" % i).digest() for i in range(n_vals)]
+    pubs = [host_ed.public_key_from_seed(s) for s in seeds]
+    return seeds, pubs, ed25519_batch.EpochTables(pubs)
+
+
+def test_prepare_compact_native_matches_python():
+    seeds, pubs, epoch = _mk_epoch()
+    rng = np.random.default_rng(9)
+    msgs, sigs, vidx = [], [], []
+    # honest votes
+    for i in range(40):
+        m = b"msg-%d" % i
+        vi = i % 4
+        msgs.append(m)
+        sigs.append(host_ed.sign(seeds[vi], m))
+        vidx.append(vi)
+    # S >= L: craft sig with S = L (and S = 2^256-1)
+    for bad_s in [L, 2**256 - 1, L - 1]:  # L-1 passes ScMinimal (sig invalid later)
+        msgs.append(b"bad-s")
+        sigs.append(bytes(32) + bad_s.to_bytes(32, "little"))
+        vidx.append(0)
+    # wrong-length signatures
+    for ln in [0, 63, 65]:
+        msgs.append(b"bad-len")
+        sigs.append(b"\x01" * ln)
+        vidx.append(1)
+    # off-range validator indices
+    for bad_vi in [-1, 4, 1000]:
+        m = b"bad-vi"
+        msgs.append(m)
+        sigs.append(host_ed.sign(seeds[0], m))
+        vidx.append(bad_vi)
+    # empty message
+    msgs.append(b"")
+    sigs.append(host_ed.sign(seeds[2], b""))
+    vidx.append(2)
+
+    vidx = np.array(vidx)
+    a = ed25519_batch._prepare_compact_native(msgs, sigs, vidx, epoch)
+    b = ed25519_batch._prepare_compact_py(msgs, sigs, vidx, epoch)
+    np.testing.assert_array_equal(a.pre_ok, b.pre_ok)
+    np.testing.assert_array_equal(a.s_nibbles, b.s_nibbles)
+    np.testing.assert_array_equal(a.h_nibbles, b.h_nibbles)
+    np.testing.assert_array_equal(a.val_idx, b.val_idx)
+    np.testing.assert_array_equal(a.r_y, b.r_y)
+    np.testing.assert_array_equal(a.r_sign, b.r_sign)
+    # sanity: the honest votes all pass prechecks, the crafted ones fail
+    assert a.pre_ok[:40].all()
+    assert not a.pre_ok[40:42].any()  # S >= L
+    assert a.pre_ok[42]  # S = L - 1 is minimal
+    assert not a.pre_ok[43:49].any()  # bad lengths + bad indices
+
+
+def test_off_curve_key_rejected_in_prechecks():
+    seeds, pubs, _ = _mk_epoch()
+    off_curve = bytes([2] + [0] * 31)  # y=2 has no square x (checked below)
+    assert host_ed.point_decompress(off_curve) is None
+    epoch = ed25519_batch.EpochTables([pubs[0], off_curve])
+    m = b"oc"
+    sigs = [host_ed.sign(seeds[0], m), host_ed.sign(seeds[0], m)]
+    a = ed25519_batch._prepare_compact_native([m, m], sigs, np.array([0, 1]), epoch)
+    b = ed25519_batch._prepare_compact_py([m, m], sigs, np.array([0, 1]), epoch)
+    np.testing.assert_array_equal(a.pre_ok, b.pre_ok)
+    assert list(a.pre_ok) == [True, False]
+
+
+def test_malformed_pubkey_length_does_not_misalign_epoch():
+    """A wrong-length pubkey must not crash EpochTables nor shift later
+    validators' rows in the native prep gather (r3 review finding)."""
+    seeds, pubs, _ = _mk_epoch()
+    epoch = ed25519_batch.EpochTables([pubs[0], b"\x01" * 31, pubs[1]])
+    assert list(epoch.key_ok) == [True, False, True]
+    m = b"align"
+    sigs = [
+        host_ed.sign(seeds[0], m),
+        host_ed.sign(seeds[0], m),
+        host_ed.sign(seeds[1], m),
+    ]
+    a = ed25519_batch._prepare_compact_native(
+        [m, m, m], sigs, np.array([0, 1, 2]), epoch
+    )
+    b = ed25519_batch._prepare_compact_py(
+        [m, m, m], sigs, np.array([0, 1, 2]), epoch
+    )
+    np.testing.assert_array_equal(a.pre_ok, b.pre_ok)
+    np.testing.assert_array_equal(a.h_nibbles, b.h_nibbles)
+    assert list(a.pre_ok) == [True, False, True]
+    # validator 2's h must be computed with ITS OWN key bytes
+    digest = hashlib.sha512(sigs[2][:32] + pubs[1] + m).digest()
+    want = int.from_bytes(digest, "little") % L
+    got = 0
+    for nib in a.h_nibbles[2]:
+        got = (got << 4) | int(nib)
+    assert got == want

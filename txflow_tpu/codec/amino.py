@@ -31,6 +31,8 @@ _U64_MASK = (1 << 64) - 1
 
 def uvarint(n: int) -> bytes:
     """LEB128 unsigned varint."""
+    if 0 <= n < 0x80:
+        return _SMALL[n]  # the overwhelmingly common case on this wire
     if n < 0:
         raise ValueError("uvarint of negative value")
     out = bytearray()
@@ -42,6 +44,9 @@ def uvarint(n: int) -> bytes:
         else:
             out.append(b)
             return bytes(out)
+
+
+_SMALL = [bytes((i,)) for i in range(0x80)]
 
 
 def varint(n: int) -> bytes:
@@ -65,16 +70,26 @@ def encode_time_body(unix_ns: int) -> bytes:
     """Body of an amino-embedded time.Time given integer unix nanoseconds.
 
     seconds = floor(unix_ns / 1e9) (matches Go Time.Unix() for negative
-    times), nanos in [0, 1e9). Each field elided when zero.
+    times), nanos in [0, 1e9). Each field elided when zero. Runs on the
+    per-vote encode/sign-bytes paths, hence the inlined varint loops
+    (field keys 0x08/0x10 = (fnum << 3) | TYP3_VARINT).
     """
     seconds, nanos = divmod(unix_ns, 1_000_000_000)
     out = bytearray()
     if seconds != 0:
-        out += field_key(1, TYP3_VARINT)
-        out += varint(seconds)
+        out.append(0x08)
+        n = seconds & _U64_MASK
+        while n > 0x7F:
+            out.append((n & 0x7F) | 0x80)
+            n >>= 7
+        out.append(n)
     if nanos != 0:
-        out += field_key(2, TYP3_VARINT)
-        out += uvarint(nanos)
+        out.append(0x10)
+        n = nanos
+        while n > 0x7F:
+            out.append((n & 0x7F) | 0x80)
+            n >>= 7
+        out.append(n)
     return bytes(out)
 
 

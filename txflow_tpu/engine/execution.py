@@ -35,8 +35,11 @@ class TxExecutor:
     def set_event_bus(self, bus: EventBus) -> None:
         self.event_bus = bus
 
-    def apply_tx(self, height: int, tx: bytes):
-        """Execute + commit one fast-path tx; returns (app_hash, deliver_res)."""
+    def apply_tx(self, height: int, tx: bytes, tx_hash: str | None = None):
+        """Execute + commit one fast-path tx; returns (app_hash, deliver_res).
+
+        tx_hash, when the caller already has it (the engine always does),
+        skips a per-commit sha256+hexdigest in the event payload."""
         t0 = time.perf_counter()
         deliver_res = self._exec_tx_on_proxy_app(tx)
         self.metrics.tx_processing_time.observe(time.perf_counter() - t0)
@@ -47,7 +50,7 @@ class TxExecutor:
 
         failpoints.fail("txflow-after-commit")
 
-        self._fire_events(height, tx, deliver_res)
+        self._fire_events(height, tx, deliver_res, tx_hash)
         return app_hash, deliver_res
 
     def _exec_tx_on_proxy_app(self, tx: bytes):
@@ -76,7 +79,9 @@ class TxExecutor:
         del res
         return commit_res.data
 
-    def _fire_events(self, height: int, tx: bytes, deliver_res) -> None:
+    def _fire_events(
+        self, height: int, tx: bytes, deliver_res, tx_hash: str | None = None
+    ) -> None:
         if self.event_bus is None:
             return
         self.event_bus.publish(
@@ -84,7 +89,7 @@ class TxExecutor:
             EventDataTx(
                 height=height,
                 tx=tx,
-                tx_hash=hashlib.sha256(tx).hexdigest().upper(),
+                tx_hash=tx_hash or hashlib.sha256(tx).hexdigest().upper(),
                 result_code=deliver_res.code,
                 result_data=deliver_res.data,
                 result_log=deliver_res.log,

@@ -350,7 +350,15 @@ class TxFlow:
         if tx is None:
             tx = self.mempool.get_tx(vs.tx_key)
         if tx is not None:
-            app_hash, _ = self.tx_executor.apply_tx(self.height, tx)
+            # the hash handed to events/indexer must describe the tx actually
+            # fetched and applied: tx came from mempool.get_tx(vs.tx_key), and
+            # the mempool keys by sha256, so the key IS sha256(tx). vs.tx_hash
+            # is NOT safe here — sign bytes zero TxKey (module docstring of
+            # types.tx_vote), so a relayer can pair a valid signature for hash
+            # H with a forged tx_key and desynchronize the two.
+            app_hash, _ = self.tx_executor.apply_tx(
+                self.height, tx, vs.tx_key.hex().upper()
+            )
             self.app_hash = app_hash
             self.metrics.committed_txs.add(1)
             try:

@@ -1,0 +1,131 @@
+"""Native host-runtime pieces, built on demand with the system C compiler.
+
+``prep.c`` implements the batched verify prep (SHA-512 + mod-L + ScMinimal)
+that feeds the device kernel; the Python fallback in ops/ed25519_batch.py
+remains both the parity oracle and the no-compiler path. The library is
+(re)built lazily the first time it is needed — one ``cc -O3 -shared`` per
+source change, cached as ``_prep.so`` next to the source.
+
+No pip/apt dependencies: plain ctypes against a cc-built shared object.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "prep.c")
+_SO = os.path.join(_DIR, "_prep.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    """Compile prep.c -> _prep.so if missing or stale. True on success."""
+    try:
+        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return True
+    except OSError:
+        return False
+    tmp = _SO + ".tmp%d" % os.getpid()
+    for cc in ("cc", "gcc", "g++"):
+        try:
+            r = subprocess.run(
+                [cc, "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+                capture_output=True,
+                timeout=120,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if r.returncode == 0:
+            os.replace(tmp, _SO)  # atomic vs concurrent builders
+            return True
+    try:
+        os.unlink(tmp)
+    except OSError:
+        pass
+    return False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.txflow_prep_batch.argtypes = [
+            u8p, i64p, u8p, u8p, u8p, ctypes.c_int64, u8p, u8p, u8p,
+        ]
+        lib.txflow_prep_batch.restype = None
+        lib.txflow_sha512.argtypes = [u8p, ctypes.c_size_t, u8p]
+        lib.txflow_sha512.restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def sha512(data: bytes) -> bytes:
+    """One-shot SHA-512 through the native module (parity-test surface)."""
+    lib = _load()
+    assert lib is not None
+    buf = np.frombuffer(data, np.uint8) if data else np.zeros(0, np.uint8)
+    out = np.zeros(64, np.uint8)
+    lib.txflow_sha512(_u8p(np.ascontiguousarray(buf)), len(data), _u8p(out))
+    return out.tobytes()
+
+
+def prep_batch(
+    msgs_concat: np.ndarray,
+    offsets: np.ndarray,
+    sigs: np.ndarray,
+    pubs: np.ndarray,
+    ok_in: np.ndarray,
+):
+    """Batched S/h prep: returns (s_le [n,32], h_le [n,32], ok [n] bool).
+
+    msgs_concat: uint8[total]; offsets: int64[n+1]; sigs: uint8[n,64];
+    pubs: uint8[n,32] (pre-gathered per vote); ok_in: uint8[n] (host checks:
+    signature length, validator index range, key decompresses).
+    """
+    lib = _load()
+    assert lib is not None
+    n = len(ok_in)
+    s_le = np.zeros((n, 32), np.uint8)
+    h_le = np.zeros((n, 32), np.uint8)
+    ok = np.zeros(n, np.uint8)
+    lib.txflow_prep_batch(
+        _u8p(np.ascontiguousarray(msgs_concat)),
+        np.ascontiguousarray(offsets, np.int64).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int64)
+        ),
+        _u8p(np.ascontiguousarray(sigs)),
+        _u8p(np.ascontiguousarray(pubs)),
+        _u8p(np.ascontiguousarray(ok_in, np.uint8)),
+        n,
+        _u8p(s_le),
+        _u8p(h_le),
+        _u8p(ok),
+    )
+    return s_le, h_le, ok.astype(bool)

@@ -43,6 +43,7 @@ class LocalNet:
         wal_dir: str = "",
         verifier=None,
         rpc: bool = False,  # True: each node serves HTTP RPC on an ephemeral port
+        index_txs: bool = True,
     ):
         self.chain_id = chain_id
         if priv_vals is None:
@@ -80,6 +81,7 @@ class LocalNet:
                     # node keeps its consensus identity either way
                     sign_votes=sign,
                     rpc_port=0 if rpc else None,
+                    index_txs=index_txs,
                     ticker_factory=ticker_factory,
                     consensus_wal_path=(
                         f"{wal_dir}/node{i}-consensus.wal" if wal_dir else ""

@@ -61,6 +61,9 @@ class NodeConfig:
     # 1007); port 0 = ephemeral (read Node.rpc.addr), None = no listener
     rpc_port: int | None = None
     rpc_host: str = "127.0.0.1"
+    # tx indexer (reference TxIndexConfig "kv"/"null", node/node.go:211-238):
+    # False = the "null" indexer, no per-commit index rows
+    index_txs: bool = True
     # ed25519 node key seed: enables authenticated secret connections on
     # TCP links (reference p2p.LoadOrGenNodeKey, node/node.go:72)
     node_key_seed: bytes | None = None
@@ -108,12 +111,18 @@ class Node:
         self.app = app
         self.proxy_app = AppConns(app)
 
-        # -- event bus + tx indexer service (node/node.go:585, :211-238) --
+        # -- event bus + tx indexer service (node/node.go:585, :211-238).
+        # The indexer follows the reference's config gate (index rows are
+        # unbounded MemDB growth): on by default like the reference's
+        # "kv" indexer, but benches/workers that never serve /tx_search
+        # switch it off via NodeConfig.index_txs --
         self.event_bus = EventBus()
-        from ..services.indexer import TxIndexer
+        self.tx_indexer = None
+        if nc.index_txs:
+            from ..services.indexer import TxIndexer
 
-        self.tx_indexer = TxIndexer(MemDB())
-        self.tx_indexer.subscribe(self.event_bus)
+            self.tx_indexer = TxIndexer(MemDB())
+            self.tx_indexer.subscribe(self.event_bus)
 
         # -- pools (node/node.go:627-633); WALs per node under the config's
         # wal_dir (reference InitWAL at OnStart, node/node.go:805-808) --

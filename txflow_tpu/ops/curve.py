@@ -93,6 +93,74 @@ def table_select(table, nibble):
     return (sel[..., 0, :], sel[..., 1, :], sel[..., 2, :], sel[..., 3, :])
 
 
+def table_select_indexed(tables_flat, idx):
+    """Select PNiels entries from a SHARED flattened table by scalar index.
+
+    tables_flat: [E, 4*32] (all validators' window entries, row-major);
+    idx: int32 [...] in [0, E). Two lowerings, same bit-exact result:
+
+    - E <= 2048: one-hot matmul [..., E] @ [E, 128]. Inputs cast to
+      bfloat16 — exact, since one-hot entries are 0/1 and limbs are < 256
+      (8 significand bits) — with a float32 accumulator, so the MXU does
+      the select instead of the VPU walking a gather. This is the hot
+      configuration (validator sets <= 128).
+    - E > 2048: plain row gather (the one-hot operand would dwarf the
+      table itself).
+
+    Either way the full per-item window table [B, 16, 4, 32] of the naive
+    path is never materialized — selection happens inside the scan step,
+    one window at a time (the materialized form measured super-linear HBM
+    cost past ~16k votes on v5e, r3).
+    """
+    E = tables_flat.shape[0]
+    if E <= 2048:
+        onehot = (
+            idx[..., None] == jnp.arange(E, dtype=jnp.int32)
+        ).astype(jnp.bfloat16)
+        sel = jax.lax.dot_general(
+            onehot,
+            tables_flat.astype(jnp.bfloat16),
+            (((onehot.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)
+    else:
+        sel = jnp.take(tables_flat, idx, axis=0)
+    sel = sel.reshape(*idx.shape, 4, fe.NLIMB)
+    return (sel[..., 0, :], sel[..., 1, :], sel[..., 2, :], sel[..., 3, :])
+
+
+def double_scalar_mul_indexed(
+    s_nibbles, h_nibbles, base_table, tables, val_idx, axis_name=None
+):
+    """[s]B + [h]A' with A' looked up per item from shared epoch tables.
+
+    tables: [V, 16, 4, 32] device-resident epoch tables; val_idx: int32 [B].
+    Identical results to ``double_scalar_mul`` over gathered per-item
+    tables, but the gather collapses to an in-loop indexed select (see
+    ``table_select_indexed``), so HBM holds one [V*16, 128] table total
+    instead of 8 KiB per vote.
+    """
+    n_vals = tables.shape[0]
+    tables_flat = tables.reshape(n_vals * TABLE_SIZE, 4 * fe.NLIMB)
+    base = val_idx * TABLE_SIZE
+
+    def step(w, acc):
+        acc = ext_double(acc, compute_t=False)
+        acc = ext_double(acc, compute_t=False)
+        acc = ext_double(acc, compute_t=False)
+        acc = ext_double(acc, compute_t=True)
+        s_nib = jax.lax.dynamic_index_in_dim(s_nibbles, w, axis=-1, keepdims=False)
+        h_nib = jax.lax.dynamic_index_in_dim(h_nibbles, w, axis=-1, keepdims=False)
+        acc = pniels_add(acc, table_select(base_table, s_nib))
+        acc = pniels_add(acc, table_select_indexed(tables_flat, base + h_nib))
+        return acc
+
+    init = ext_identity(s_nibbles.shape[:-1])
+    if axis_name is not None:
+        init = tuple(jax.lax.pvary(t, axis_name) for t in init)
+    return jax.lax.fori_loop(0, NWINDOWS, step, init)
+
+
 def double_scalar_mul(s_nibbles, h_nibbles, base_table, a_tables, axis_name=None):
     """Compute [s]B + [h]A' batched, A' given by per-item PNiels tables.
 

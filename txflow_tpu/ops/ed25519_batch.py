@@ -78,6 +78,20 @@ class EpochTables:
         self.pub_keys = list(pub_keys)
         self.tables = np.stack(tables) if tables else np.zeros((0, 16, 4, 32), np.int32)
         self.key_ok = np.array(oks, dtype=bool)
+        # [V, 32] uint8 key bytes for the native batch prep's per-vote
+        # gather. Malformed key lengths (key_ok already False -> the vote is
+        # force-rejected) get a zero row: joining raw would crash or, worse,
+        # shift every later validator's row by the length error.
+        self.pub_arr = (
+            np.frombuffer(
+                b"".join(pk if len(pk) == 32 else bytes(32) for pk in pub_keys),
+                np.uint8,
+            )
+            .reshape(-1, 32)
+            .copy()
+            if pub_keys
+            else np.zeros((0, 32), np.uint8)
+        )
         self._device_tables = None
 
     def device_tables(self):
@@ -190,7 +204,67 @@ def prepare_compact(
     val_idx: np.ndarray,
     epoch: EpochTables,
 ) -> CompactBatch:
-    """Vectorized host prep: only SHA-512 folding stays a Python loop."""
+    """Host prep: native C batch (SHA-512 + mod L + ScMinimal) when the
+    compiler-built module is available, else the pure-Python loop below —
+    the parity oracle (tests/test_native_prep.py pins them identical)."""
+    from .. import native
+
+    if len(msgs) and native.available():
+        return _prepare_compact_native(msgs, sigs, val_idx, epoch)
+    return _prepare_compact_py(msgs, sigs, val_idx, epoch)
+
+
+def _prepare_compact_native(
+    msgs: list[bytes],
+    sigs: list[bytes],
+    val_idx: np.ndarray,
+    epoch: EpochTables,
+) -> CompactBatch:
+    from .. import native
+
+    n = len(msgs)
+    n_vals = len(epoch.pub_keys)
+    vi = np.asarray(val_idx, dtype=np.int64)
+    clipped = np.clip(vi, 0, max(n_vals - 1, 0))
+    idx_ok = (vi >= 0) & (vi < n_vals)
+    sig_ok = np.fromiter((len(s) == 64 for s in sigs), bool, n)
+    sig_cat = (
+        b"".join(sigs)
+        if bool(sig_ok.all())
+        else b"".join(s if len(s) == 64 else _ZERO64 for s in sigs)
+    )
+    sig_arr = np.frombuffer(sig_cat, np.uint8).reshape(n, 64)
+    offs = np.zeros(n + 1, np.int64)
+    np.cumsum(np.fromiter((len(m) for m in msgs), np.int64, n), out=offs[1:])
+    msg_cat = np.frombuffer(b"".join(msgs), np.uint8)
+    ok_in = (idx_ok & sig_ok & (epoch.key_ok[clipped] if n_vals else False)).astype(
+        np.uint8
+    )
+    pubs = epoch.pub_arr[clipped] if n_vals else np.zeros((n, 32), np.uint8)
+    s_le, h_le, pre_ok = native.prep_batch(msg_cat, offs, sig_arr, pubs, ok_in)
+    # match the Python path bit-for-bit: failed rows stay all-zero
+    r_y = np.where(pre_ok[:, None], sig_arr[:, :32], 0).astype(np.uint8)
+    r_sign = (r_y[:, 31] >> 7).astype(np.uint8)
+    r_y[:, 31] &= 0x7F
+    return CompactBatch(
+        nibbles_from_le_bytes(s_le),
+        nibbles_from_le_bytes(h_le),
+        clipped.astype(np.int32),
+        r_y,
+        r_sign,
+        pre_ok,
+    )
+
+
+_ZERO64 = bytes(64)
+
+
+def _prepare_compact_py(
+    msgs: list[bytes],
+    sigs: list[bytes],
+    val_idx: np.ndarray,
+    epoch: EpochTables,
+) -> CompactBatch:
     n = len(msgs)
     n_vals = len(epoch.pub_keys)
     vi = np.asarray(val_idx, dtype=np.int64)
@@ -235,14 +309,15 @@ def verify_kernel_gather(
 
     tables: [V, 16, 4, 32] int32, device-resident per epoch. Per-vote inputs
     are compact uint8; widened to int32 on device. Decisions are identical
-    to ``verify_kernel``.
+    to ``verify_kernel``; the per-item window table is never materialized
+    (``curve.double_scalar_mul_indexed`` selects inside the scan step).
     """
-    a_tables = jnp.take(tables, val_idx, axis=0)
-    p = curve.double_scalar_mul(
+    p = curve.double_scalar_mul_indexed(
         s_nibbles.astype(jnp.int32),
         h_nibbles.astype(jnp.int32),
         jnp.asarray(curve.BASE_TABLE),
-        a_tables,
+        tables,
+        val_idx,
         axis_name=axis_name,
     )
     y, x_parity = curve.ext_encode(p)

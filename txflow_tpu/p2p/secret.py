@@ -112,15 +112,24 @@ class SecretConnection:
         self._send_ctr = 0
         self._recv_ctr = 0
 
-        # 4: authenticate identities over the encrypted channel
+        # 4: authenticate identities over the encrypted channel. The signed
+        # material binds the signer's ROLE via its own ephemeral key: both
+        # directions share `challenge`, so a bare signature over it could be
+        # reflected back by a keyless man-in-the-middle (decrypt our auth
+        # frame, re-encrypt under its own send key) to authenticate as us.
+        # Signing challenge||own-ephemeral makes the two directions sign
+        # different messages (echoing our ephemeral back would leave the
+        # attacker without the DH shared secret, so it cannot re-frame).
         node_pub = ed25519.public_key_from_seed(node_seed)
-        sig = ed25519.sign(node_seed, challenge)
+        sig = ed25519.sign(node_seed, challenge + eph_pub)
         self._send_frame(0xFF, node_pub + sig)
         chan, auth = self._recv_frame()
         if chan != 0xFF or len(auth) != 96:
             raise ValueError("secret connection: bad auth frame")
         peer_pub, peer_sig = auth[:32], auth[32:]
-        if not ed25519.verify(peer_pub, challenge, peer_sig):
+        if peer_pub == node_pub:
+            raise ValueError("secret connection: peer claims our own identity")
+        if not ed25519.verify(peer_pub, challenge + peer_eph, peer_sig):
             raise ValueError("secret connection: peer identity signature invalid")
         self.peer_pub_key = peer_pub
         self.peer_id = address_hash(peer_pub).hex().upper()

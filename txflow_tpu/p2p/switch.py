@@ -112,12 +112,23 @@ class SwitchError(Exception):
 
 class Switch:
     def __init__(self, node_id: str, node_seed: bytes | None = None):
-        self.node_id = node_id
         # ed25519 node key: when set, TCP links use the authenticated
         # SecretConnection and peer ids are derived from VERIFIED pubkeys
         # (upstream rides secret connections for every socket,
         # node/node.go:420-505); None = plaintext string handshake
-        # (in-proc pipes, legacy tests)
+        # (in-proc pipes, legacy tests).
+        #
+        # With a key, our OWN advertised id must be the same verified-key
+        # address our peers will register us under — otherwise PEX compares
+        # book ids against verified ids, never sees a match, and redials
+        # every known peer forever (r3 review finding).
+        if node_seed is not None:
+            from ..crypto import ed25519 as _ed
+            from ..crypto.hash import address_hash as _ah
+
+            self.node_id = _ah(_ed.public_key_from_seed(node_seed)).hex().upper()
+        else:
+            self.node_id = node_id
         self._node_seed = node_seed
         self.reactors: dict[str, Reactor] = {}
         self._chan_to_reactor: dict[int, Reactor] = {}

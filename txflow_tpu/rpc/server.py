@@ -249,6 +249,8 @@ class RPCServer:
         """Indexer queries (reference tx indexer service): by height or by
         tag (?height=N | ?key=app.key&value=hex-or-str)."""
         idx = self.node.tx_indexer
+        if idx is None:
+            raise ValueError("tx indexing is disabled on this node")
         if "height" in q:
             hashes = idx.by_height(int(q["height"]))
         elif "key" in q:

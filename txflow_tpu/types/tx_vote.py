@@ -10,7 +10,7 @@ bytes. Preserving that quirk is required for signature compatibility.
 from __future__ import annotations
 
 import time as _time
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 
 from ..codec import amino
 from ..crypto import ed25519
@@ -31,24 +31,35 @@ _SEMANTIC_FIELDS = frozenset(
 def canonical_sign_bytes(
     chain_id: str, height: int, tx_hash: str, timestamp_ns: int
 ) -> bytes:
-    """Length-prefixed amino encoding of CanonicalTxVote."""
+    """Length-prefixed amino encoding of CanonicalTxVote.
+
+    Hand-tightened: this runs once per (vote, node) on the verify path
+    (a top host cost in the r3 pipeline profile). Field-key bytes are the
+    precomputed amino constants — (fnum << 3) | typ3, all < 0x80 — and the
+    layout is pinned by the golden vectors in tests/test_tx_vote.py.
+    """
     body = bytearray()
     if height != 0:
-        body += amino.field_key(1, amino.TYP3_8BYTE)
-        body += amino.fixed64(height)
+        body += b"\x09"  # field 1, TYP3_8BYTE
+        body += (height & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "little")
     if tx_hash:
-        body += amino.field_key(2, amino.TYP3_BYTELEN)
-        body += amino.length_prefixed(tx_hash.encode())
+        hb = tx_hash.encode()
+        body += b"\x12"  # field 2, TYP3_BYTELEN
+        body += amino.uvarint(len(hb))
+        body += hb
     # TxKey: fixed-size array, never elided; canonicalization leaves it zero.
-    body += amino.field_key(3, amino.TYP3_BYTELEN)
-    body += amino.length_prefixed(_ZERO_TXKEY)
+    body += b"\x1a\x20"  # field 3, TYP3_BYTELEN, len 32
+    body += _ZERO_TXKEY
     ts_body = amino.encode_time_body(timestamp_ns)
     if ts_body:
-        body += amino.field_key(4, amino.TYP3_BYTELEN)
-        body += amino.length_prefixed(ts_body)
+        body += b"\x22"  # field 4, TYP3_BYTELEN
+        body += amino.uvarint(len(ts_body))
+        body += ts_body
     if chain_id:
-        body += amino.field_key(5, amino.TYP3_BYTELEN)
-        body += amino.length_prefixed(chain_id.encode())
+        cb = chain_id.encode()
+        body += b"\x2a"  # field 5, TYP3_BYTELEN
+        body += amino.uvarint(len(cb))
+        body += cb
     return amino.length_prefixed(bytes(body))
 
 
@@ -63,7 +74,8 @@ class TxVote:
     # encode caches: a signed vote is immutable, and re-deriving sign bytes
     # and wire bytes per engine step measured as a top host cost at bench
     # scale (r3 step profile). Signers mutate fields BEFORE the first
-    # encode, so lazy first-use caching is safe; ``copy()`` drops them.
+    # encode, so lazy first-use caching is safe; copies carry the caches
+    # (any later field write clears them via __setattr__).
     _sb_cache: tuple | None = field(
         default=None, repr=False, compare=False
     )
@@ -117,7 +129,21 @@ class TxVote:
         return len(encode_tx_vote(self))
 
     def copy(self) -> "TxVote":
-        return replace(self, _sb_cache=None, _wire_cache=None)
+        # caches travel with the copy: they only describe the semantic
+        # fields, and any later field write clears them via __setattr__ —
+        # dropping them here made every commit-certificate encode a full
+        # re-serialize (r3 pipeline profile)
+        v = TxVote.__new__(TxVote)
+        oset = object.__setattr__
+        oset(v, "height", self.height)
+        oset(v, "tx_hash", self.tx_hash)
+        oset(v, "tx_key", self.tx_key)
+        oset(v, "timestamp_ns", self.timestamp_ns)
+        oset(v, "validator_address", self.validator_address)
+        oset(v, "signature", self.signature)
+        oset(v, "_sb_cache", self._sb_cache)
+        oset(v, "_wire_cache", self._wire_cache)
+        return v
 
     def vote_key(self) -> bytes:
         """sha256(signature) — dedup cache key (txvotepool/txvotepool.go:467-469)."""
@@ -153,41 +179,201 @@ def encode_tx_vote(vote: TxVote) -> bytes:
     return out
 
 
+def _uv(data: bytes, pos: int, end: int) -> tuple[int, int]:
+    """Uvarint continuation path (Go binary.Uvarint overflow rules)."""
+    n = 0
+    shift = 0
+    while True:
+        if pos >= end:
+            raise ValueError("truncated uvarint")
+        b = data[pos]
+        pos += 1
+        if shift == 63 and b > 1:
+            raise ValueError("uvarint overflows 64 bits")
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("uvarint overflows 64 bits")
+
+
 def decode_tx_vote(data: bytes) -> TxVote:
-    r = amino.AminoReader(data)
+    """Hand-rolled single-pass parser.
+
+    This runs once per gossiped vote per node — the top pipeline cost in
+    the r3 stub-verify profile — so it inlines the one-byte-varint fast
+    path and constructs the TxVote via object.__setattr__ instead of the
+    guarded dataclass path. The accept-set is identical to the AminoReader
+    formulation (pinned by tests/test_tx_vote.py + test_amino.py).
+
+    ``canonical`` tracks whether the input is exactly the byte string our
+    own encoder emits (fields strictly ordered, no unknown fields, no
+    explicitly-encoded defaults, normalized time body): only then are the
+    input bytes cached as the vote's wire form, so re-gossip and TxStore
+    certificate encoding never re-serialize. Non-canonical peer encodings
+    fall back to a real re-serialize like the reference (Go amino
+    re-marshals from the struct). Over-long varints are the one
+    undetected variance — the cached bytes would still be a valid
+    encoding of the same vote; dedup keys off sha256(signature) and sign
+    bytes are rebuilt from fields, so nothing depends on byte
+    canonicality.
+    """
+    pos = 0
+    end = len(data)
     height = 0
     tx_hash = ""
     tx_key = _ZERO_TXKEY
     timestamp_ns = 0
     validator_address = b""
     signature = None
-    while not r.eof():
-        fnum, typ3 = r.read_field_key()
-        if fnum == 1 and typ3 == amino.TYP3_VARINT:
-            height = r.read_varint()
-        elif fnum == 2 and typ3 == amino.TYP3_BYTELEN:
-            tx_hash = r.read_bytes().decode()
-        elif fnum == 3 and typ3 == amino.TYP3_BYTELEN:
-            tx_key = r.read_bytes()
-            if len(tx_key) != 32:
-                # Go amino unmarshals into [sha256.Size]byte and errors on
-                # any other length; keep the wire accept-set identical.
-                raise ValueError(
-                    f"TxKey must be 32 bytes, got {len(tx_key)}"
-                )
-        elif fnum == 4 and typ3 == amino.TYP3_BYTELEN:
-            timestamp_ns = amino.decode_time_body(r.read_bytes())
-        elif fnum == 5 and typ3 == amino.TYP3_BYTELEN:
-            validator_address = r.read_bytes()
-        elif fnum == 6 and typ3 == amino.TYP3_BYTELEN:
-            signature = r.read_bytes()
+    canonical = True
+    prev_fnum = 0
+    try:
+        while pos < end:
+            b = data[pos]
+            if b < 0x80:
+                key = b
+                pos += 1
+            else:
+                key, pos = _uv(data, pos, end)
+            fnum = key >> 3
+            typ3 = key & 7
+            if fnum <= prev_fnum:
+                canonical = False
+            prev_fnum = fnum
+            if typ3 == 2:  # BYTELEN
+                b = data[pos]
+                if b < 0x80:
+                    ln = b
+                    pos += 1
+                else:
+                    ln, pos = _uv(data, pos, end)
+                npos = pos + ln
+                if npos > end:
+                    raise ValueError("truncated byte field")
+                seg = data[pos:npos]
+                pos = npos
+                if fnum == 2:
+                    tx_hash = seg.decode()
+                    if not tx_hash:
+                        canonical = False
+                elif fnum == 3:
+                    if ln != 32:
+                        # Go amino unmarshals into [sha256.Size]byte and
+                        # errors on any other length; keep the wire
+                        # accept-set identical.
+                        raise ValueError(f"TxKey must be 32 bytes, got {ln}")
+                    tx_key = seg
+                elif fnum == 4:
+                    timestamp_ns, ts_canon = _decode_ts_body(seg)
+                    if not ts_canon:
+                        canonical = False
+                elif fnum == 5:
+                    validator_address = seg
+                    if not seg:
+                        canonical = False
+                elif fnum == 6:
+                    signature = seg
+                    if not seg:
+                        canonical = False
+                else:
+                    canonical = False  # unknown BYTELEN field: skipped
+            elif typ3 == 0:  # VARINT
+                b = data[pos]
+                if b < 0x80:
+                    v = b
+                    pos += 1
+                else:
+                    v, pos = _uv(data, pos, end)
+                if fnum == 1:
+                    height = v - (1 << 64) if v >= 1 << 63 else v
+                    if height == 0:
+                        canonical = False
+                else:
+                    canonical = False  # unknown varint field: skipped
+            elif typ3 == 1:  # 8BYTE
+                if pos + 8 > end:
+                    raise ValueError("truncated fixed64")
+                pos += 8
+                canonical = False  # no fixed64 field in TxVote
+            else:
+                raise ValueError(f"unknown typ3 {typ3}")
+    except IndexError:
+        raise ValueError("truncated uvarint") from None
+    vote = TxVote.__new__(TxVote)
+    oset = object.__setattr__
+    oset(vote, "height", height)
+    oset(vote, "tx_hash", tx_hash)
+    oset(vote, "tx_key", tx_key)
+    oset(vote, "timestamp_ns", timestamp_ns)
+    oset(vote, "validator_address", validator_address)
+    oset(vote, "signature", signature)
+    oset(vote, "_sb_cache", None)
+    if signature and canonical and tx_key is not _ZERO_TXKEY:
+        oset(vote, "_wire_cache", bytes(data))
+    else:
+        oset(vote, "_wire_cache", None)
+    return vote
+
+
+def _decode_ts_body(body: bytes) -> tuple[int, bool]:
+    """(unix_ns, canonical): canonical iff body == encode_time_body(ns)."""
+    if not body:
+        # encode_time_body(0) elides the whole field — an explicit empty
+        # field 4 is never something our encoder emits
+        return 0, False
+    pos = 0
+    end = len(body)
+    seconds = 0
+    nanos = 0
+    canonical = True
+    prev = 0
+    while pos < end:
+        b = body[pos]
+        if b < 0x80:
+            key = b
+            pos += 1
         else:
-            r.skip_field(typ3)
-    return TxVote(
-        height=height,
-        tx_hash=tx_hash,
-        tx_key=tx_key,
-        timestamp_ns=timestamp_ns,
-        validator_address=validator_address,
-        signature=signature,
-    )
+            key, pos = _uv(body, pos, end)
+        fnum = key >> 3
+        typ3 = key & 7
+        if fnum <= prev:
+            canonical = False
+        prev = fnum
+        if typ3 == 0:
+            b = body[pos] if pos < end else 0x80
+            if b < 0x80:
+                v = b
+                pos += 1
+            else:
+                v, pos = _uv(body, pos, end)
+            if fnum == 1:
+                seconds = v - (1 << 64) if v >= 1 << 63 else v
+                if seconds == 0:
+                    canonical = False
+            elif fnum == 2:
+                nanos = v
+                if not 0 < v < 1_000_000_000:
+                    canonical = False
+            else:
+                canonical = False
+        elif typ3 == 1:
+            if pos + 8 > end:
+                raise ValueError("truncated fixed64")
+            pos += 8
+            canonical = False
+        elif typ3 == 2:
+            b = body[pos] if pos < end else 0x80
+            if b < 0x80:
+                ln = b
+                pos += 1
+            else:
+                ln, pos = _uv(body, pos, end)
+            if pos + ln > end:
+                raise ValueError("truncated byte field")
+            pos += ln
+            canonical = False
+        else:
+            raise ValueError(f"unknown typ3 {typ3}")
+    return seconds * 1_000_000_000 + nanos, canonical

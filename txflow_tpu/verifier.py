@@ -151,6 +151,12 @@ class DeviceVoteVerifier:
         # new batch size triggers a fresh (minutes-long on TPU) compile
         self.max_batch = max(buckets)
         self.mesh = mesh
+        # kick the native prep build NOW (cc -O3, seconds when stale): the
+        # first lazy build would otherwise land inside the first verify
+        # step, stalling the engine right as the node comes under load
+        from . import native as _native
+
+        _native.available()
         import jax
 
         if mesh is not None:
