@@ -346,7 +346,7 @@ class TxFlow:
     ) -> None:
         """Store + execute + commitpool effects (reference addVote
         :216-232 sequence); runs on the committer thread when pipelined."""
-        self.tx_store.save_tx(vs)
+        self.tx_store.save_tx(vs, votes=quorum_votes)
         if tx is None:
             tx = self.mempool.get_tx(vs.tx_key)
         if tx is not None:

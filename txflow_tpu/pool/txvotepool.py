@@ -39,7 +39,7 @@ _MSG_OVERHEAD = 8
 
 def vote_key(vote: TxVote) -> bytes:
     """sha256(signature) — the reference's txVoteKey (:467-469)."""
-    return sha256(vote.signature or b"")
+    return vote.vote_key()  # cached on the immutable vote
 
 
 @dataclass
@@ -117,6 +117,18 @@ class TxVotePool(IngestLogPool):
         with self._mtx:
             entry = self._votes.get(key)
             return entry is not None and sender_id in entry.senders
+
+    def add_sender(self, key: bytes, sender_id: int) -> bool:
+        """Record that a peer holds this vote without re-ingesting it (the
+        reactor's wire-level dup fast path). Returns False when the pool no
+        longer holds the vote — the caller must fall back to a real
+        check_tx so pool-level re-accept policy stays authoritative."""
+        with self._mtx:
+            entry = self._votes.get(key)
+            if entry is None:
+                return False
+            entry.senders.add(sender_id)
+            return True
 
     # -- ingest (reference CheckTx/CheckTxWithInfo :180-261) --
 

@@ -29,10 +29,11 @@ from ..codec import amino
 from ..p2p.base import CHANNEL_TXVOTE, ChannelDescriptor, Reactor
 from ..pool.mempool import ErrMempoolIsFull, ErrTxInCache, ErrTxTooLarge, Mempool, TxInfo
 from ..pool.txvotepool import TxVotePool
+from ..crypto.hash import sha256
 from ..types import TxVote, decode_tx_vote, encode_tx_vote
+from ..utils.cache import LRUMap
 from ..types.priv_validator import PrivValidator
 from ..types.validator import ValidatorSet
-from ..crypto.hash import sha256
 
 MSG_VOTES = 1
 MSG_HEIGHT = 2
@@ -56,14 +57,6 @@ def encode_vote_batch(votes: list[TxVote]) -> bytes:
     for v in votes:
         body += amino.length_prefixed(encode_tx_vote(v))
     return bytes(body)
-
-
-def decode_vote_batch(body: bytes) -> list[TxVote]:
-    r = amino.AminoReader(body)
-    out = []
-    while not r.eof():
-        out.append(decode_tx_vote(r.read_bytes()))
-    return out
 
 
 class TxVoteReactor(Reactor):
@@ -91,6 +84,14 @@ class TxVoteReactor(Reactor):
         self._ids_mtx = threading.Lock()
         self._threads: list[threading.Thread] = []
         self._sign_thread: threading.Thread | None = None
+        # wire-segment dedup: sha256(raw segment) -> pool vote key. Gossip
+        # delivers each vote ~2-3x (independent forwarders); decoding a dup
+        # just to have the pool's signature-dedup reject it measured ~12 us
+        # per duplicate (r3 profile). Canonical wire caching makes all
+        # forwarders emit identical bytes, so the raw segment IS a dedup
+        # key; non-canonical variants miss here and fall through to the
+        # pool's authoritative signature dedup.
+        self._seen_wire = LRUMap(1 << 16)
 
     # -- channels --
 
@@ -157,13 +158,30 @@ class TxVoteReactor(Reactor):
             raise ValueError("empty txvote message")
         msg_type = msg[0]
         if msg_type == MSG_VOTES:
-            votes = decode_vote_batch(msg[1:])  # decode error -> peer stopped
             pid = self._peer_id(peer)
-            for vote in votes:
+            r = amino.AminoReader(msg, 1)
+            pool = self.tx_vote_pool
+            seen = self._seen_wire
+            while not r.eof():
+                seg = r.read_bytes()  # decode error -> peer stopped
+                wk = sha256(seg)
+                hit = seen.get(wk)
+                if hit is not None and pool.add_sender(hit, pid):
+                    # dup AND the pool still holds it: skip decode entirely.
+                    # If the pool dropped it (purge/flush/eviction), fall
+                    # through to the authoritative decode + check_tx path —
+                    # the wire cache must never overrule the pool's own
+                    # re-accept policy (r3 review finding).
+                    continue
+                vote = decode_tx_vote(seg)
                 try:
-                    self.tx_vote_pool.check_tx(vote, TxInfo(sender_id=pid))
-                except (ErrTxInCache, ErrMempoolIsFull, ErrTxTooLarge):
+                    pool.check_tx(vote, TxInfo(sender_id=pid))
+                except ErrTxInCache:
+                    seen.put(wk, vote.vote_key())
                     continue  # reference logs and moves on
+                except (ErrMempoolIsFull, ErrTxTooLarge):
+                    continue
+                seen.put(wk, vote.vote_key())
         elif msg_type == MSG_HEIGHT:
             height, _ = amino.read_uvarint(msg, 1)
             peer.set(PEER_HEIGHT_KEY, height)

@@ -69,12 +69,20 @@ class TxStore:
 
     # -- save (reference :83-107) --
 
-    def save_tx(self, vote_set: TxVoteSet, commit: Commit | None = None) -> None:
+    def save_tx(
+        self,
+        vote_set: TxVoteSet,
+        commit: Commit | None = None,
+        votes: list[TxVote] | None = None,
+    ) -> None:
+        """votes: the caller's already-materialized vote_set.get_votes()
+        copy, so the commit path doesn't re-copy the set (r3 profile)."""
         if vote_set is None:
             raise ValueError("TxStore can only save a non-nil TxVoteSet")
         tx_hash = vote_set.tx_hash
         with self._mtx:
-            votes = vote_set.get_votes()
+            if votes is None:
+                votes = vote_set.get_votes()
             votes_blob = _encode_votes(votes)
             hash_b = tx_hash.encode()
             self.db.set(b"H:" + hash_b, votes_blob)

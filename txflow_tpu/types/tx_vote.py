@@ -80,6 +80,7 @@ class TxVote:
         default=None, repr=False, compare=False
     )
     _wire_cache: bytes | None = field(default=None, repr=False, compare=False)
+    _vk_cache: bytes | None = field(default=None, repr=False, compare=False)
 
     def __setattr__(self, name, value):
         # any semantic-field write invalidates the encode caches, so even
@@ -88,6 +89,7 @@ class TxVote:
         if name in _SEMANTIC_FIELDS:
             object.__setattr__(self, "_sb_cache", None)
             object.__setattr__(self, "_wire_cache", None)
+            object.__setattr__(self, "_vk_cache", None)
         object.__setattr__(self, name, value)
 
     def sign_bytes(self, chain_id: str) -> bytes:
@@ -143,11 +145,21 @@ class TxVote:
         oset(v, "signature", self.signature)
         oset(v, "_sb_cache", self._sb_cache)
         oset(v, "_wire_cache", self._wire_cache)
+        oset(v, "_vk_cache", self._vk_cache)
         return v
 
     def vote_key(self) -> bytes:
-        """sha256(signature) — dedup cache key (txvotepool/txvotepool.go:467-469)."""
-        return sha256(self.signature or b"")
+        """sha256(signature) — dedup cache key (txvotepool/txvotepool.go:467-469).
+
+        Cached: the pool, the engine's purge bookkeeping, and gossip dedup
+        all re-derive it for the same immutable vote (~180k calls per 12k
+        commits in the r3 profile). __setattr__ clears it on any semantic
+        field write, like the encode caches."""
+        k = self._vk_cache
+        if k is None:
+            k = sha256(self.signature or b"")
+            object.__setattr__(self, "_vk_cache", k)
+        return k
 
 
 def encode_tx_vote(vote: TxVote) -> bytes:
@@ -310,6 +322,7 @@ def decode_tx_vote(data: bytes) -> TxVote:
     oset(vote, "validator_address", validator_address)
     oset(vote, "signature", signature)
     oset(vote, "_sb_cache", None)
+    oset(vote, "_vk_cache", None)
     if signature and canonical and tx_key is not _ZERO_TXKEY:
         oset(vote, "_wire_cache", bytes(data))
     else:

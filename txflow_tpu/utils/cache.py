@@ -64,3 +64,29 @@ class NopCache:
 
     def __len__(self) -> int:
         return 0
+
+
+class LRUMap:
+    """Fixed-size LRU key->value map (wire-segment dedup in the reactors)."""
+
+    def __init__(self, size: int):
+        if size <= 0:
+            raise ValueError("cache size must be positive")
+        self.size = size
+        self._mtx = threading.Lock()
+        self._map: OrderedDict[bytes, object] = OrderedDict()
+
+    def get(self, key: bytes):
+        with self._mtx:
+            v = self._map.get(key)
+            if v is not None:
+                self._map.move_to_end(key)
+            return v
+
+    def put(self, key: bytes, value) -> None:
+        with self._mtx:
+            if key in self._map:
+                self._map.move_to_end(key)
+            elif len(self._map) >= self.size:
+                self._map.popitem(last=False)
+            self._map[key] = value
