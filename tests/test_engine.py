@@ -213,3 +213,46 @@ def test_batched_matches_scalar_reference_engine():
     # uncommitted stake identical
     for tx_hash, vs in flow_s.vote_sets.items():
         assert flow_b.vote_sets[tx_hash].stake() == vs.stake()
+
+
+def test_group_commit_matches_per_tx_commit():
+    """EngineConfig.commit_interval > 1 (ABCI Commit fence amortized over a
+    group of fast-path txs) must be observably identical to the reference-
+    faithful per-tx path: same committed set, same app tx counts, same
+    per-tx commit events, pools drained."""
+    import hashlib as _h
+
+    from txflow_tpu.node import LocalNet
+    from txflow_tpu.utils.config import test_config as make_test_config
+    from txflow_tpu.utils.events import EventTx
+
+    results = {}
+    for interval in (1, 4):
+        cfg = make_test_config()
+        cfg.engine.commit_interval = interval
+        net = LocalNet(4, use_device_verifier=False, config=cfg)
+        events = [[] for _ in net.nodes]
+        for i, node in enumerate(net.nodes):
+            node.event_bus.subscribe_callback(
+                EventTx, (lambda lst: (lambda ev: lst.append(ev.data.tx_hash)))(events[i])
+            )
+        net.start()
+        try:
+            txs = [b"gc%d-%d=v" % (interval, i) for i in range(10)]
+            for tx in txs:
+                net.broadcast_tx(tx)
+            assert net.wait_all_committed(txs, timeout=60)
+            hashes = sorted(_h.sha256(tx).hexdigest().upper() for tx in txs)
+            for i, node in enumerate(net.nodes):
+                for h in hashes:
+                    assert node.tx_store.load_tx_votes(h), (interval, h)
+                assert sorted(events[i]) == hashes, (interval, i)
+            results[interval] = {
+                "tx_counts": sorted(n.app.tx_count for n in net.nodes),
+                "committed": sorted(
+                    int(n.metrics.committed_txs.value()) for n in net.nodes
+                ),
+            }
+        finally:
+            net.stop()
+    assert results[1] == results[4], results

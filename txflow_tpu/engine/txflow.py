@@ -373,6 +373,7 @@ class TxFlow:
         from ..pool.txvotepool import vote_key as _vk
 
         purge: list[TxVote] = []
+        interval = max(1, self.config.commit_interval)
 
         def flush() -> None:
             if not purge:
@@ -382,7 +383,8 @@ class TxFlow:
             self.tx_vote_pool.update(self.height, purge)
             purge.clear()
 
-        while True:
+        stop = False
+        while not stop:
             try:
                 item = self._commit_q.get(timeout=0.05)
             except _queue.Empty:
@@ -391,15 +393,61 @@ class TxFlow:
             if item is None:  # stop() sentinel, queued after last commit
                 flush()
                 return
-            vs, votes, tx = item
+            batch = [item]
+            while len(batch) < interval:
+                try:
+                    nxt = self._commit_q.get_nowait()
+                except _queue.Empty:
+                    break
+                if nxt is None:  # commit what we have, then exit
+                    stop = True
+                    break
+                batch.append(nxt)
             try:
-                self._commit_effects(vs, votes, purge, tx)
+                self._commit_batch(batch, purge)
             except Exception:
                 import traceback
 
                 traceback.print_exc()
-            if len(purge) >= 8192 or self._commit_q.empty():
+            if stop or len(purge) >= 8192 or self._commit_q.empty():
                 flush()
+
+    def _commit_batch(self, items: list, purge: list[TxVote]) -> None:
+        """Committer-side effects for a group of decided txs.
+
+        Per tx, IN DECISION ORDER: TxStore certificate first (store-then-
+        apply, same as _commit_effects), then delivery. With
+        commit_interval > 1 the ABCI app Commit fence is amortized over the
+        group via TxExecutor.apply_tx_batch; a single-item group takes the
+        reference-faithful apply_tx path."""
+        apply_items: list[tuple] = []
+        for vs, votes, tx in items:
+            self.tx_store.save_tx(vs, votes=votes)
+            self.metrics.committed_votes.add(len(votes))
+            purge.extend(votes)
+            if tx is None:
+                tx = self.mempool.get_tx(vs.tx_key)
+            if tx is not None:
+                apply_items.append((vs, tx))
+        if not apply_items:
+            return
+        if len(apply_items) == 1:
+            vs, tx = apply_items[0]
+            app_hash, _ = self.tx_executor.apply_tx(
+                self.height, tx, vs.tx_key.hex().upper()
+            )
+        else:
+            app_hash, _ = self.tx_executor.apply_tx_batch(
+                self.height,
+                [(tx, vs.tx_key.hex().upper()) for vs, tx in apply_items],
+            )
+        self.app_hash = app_hash
+        self.metrics.committed_txs.add(len(apply_items))
+        for _, tx in apply_items:
+            try:
+                self.commitpool.check_tx(tx)
+            except Exception:
+                pass  # commitpool dup (e.g. replays) is harmless
 
     def is_tx_committed(self, tx_hash: str) -> bool:
         """Committed via EITHER path: the fast path (TxStore certificate)

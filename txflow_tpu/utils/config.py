@@ -101,6 +101,15 @@ class EngineConfig:
     # thread (SURVEY §7 hard-part 5); False = reference-faithful inline
     # commits inside the step
     pipeline_commits: bool = True
+    # group commit: the committer fences the ABCI app Commit once per up-to-
+    # this-many fast-path txs instead of per tx (reference: strictly per tx,
+    # txflowstate/execution.go:112-155). Each tx still gets its own
+    # DeliverTx, TxStore certificate, mempool removal, and commit event —
+    # only the app-Commit fence is amortized. Requires the app's hash to be
+    # a function of applied txs, not of Commit call cadence (true of the
+    # kvstore/counter apps and of the handshake replay path, which replays
+    # per tx). 1 = reference-faithful.
+    commit_interval: int = 1
 
 
 @dataclass
