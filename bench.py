@@ -152,10 +152,39 @@ def run_bench(platform: str) -> dict:
             [Validator.from_pub_key(pv.get_pub_key(), 10) for pv in priv_vals]
         )
         bucket = int(os.environ.get("BENCH_BUCKET", "4096"))
-        shared_verifier = DeviceVoteVerifier(val_set, buckets=(bucket,))
+        # two buckets: per-engine batches compile at `bucket`; the mux's
+        # merged cross-engine batches land in the 4x bucket
+        shared_verifier = DeviceVoteVerifier(val_set, buckets=(bucket, 4 * bucket))
         t0 = time.time()
+        # warm the shape combos the run will hit: (B, S) = (bucket, bucket)
+        # for solo calls, (4*bucket, bucket) for merged calls (4 engines'
+        # slot ranges sum to ~bucket), (4*bucket, 4*bucket) for the
+        # slot-heavy edge
         shared_verifier.warmup()
+        for n, n_slots in ((bucket + 1, 1), (bucket + 1, bucket + 1)):
+            shared_verifier.verify_and_tally(
+                [b""] * n, [b""] * n,
+                __import__("numpy").zeros(n, "int64"),
+                __import__("numpy").zeros(n, "int64"),
+                n_slots,
+            )
         print(f"bench: kernel warm in {time.time()-t0:.1f}s", file=sys.stderr)
+
+        # measured on-TPU: merged cross-engine batches LOST ~17% end to end
+        # (10.6k vs 12.7k votes/s) — per-vote kernel cost is nearly flat in
+        # batch size (27.6 us at 4096 vs 25.6 at 16384), so the mux's
+        # padding waste on partial merges + gather latency outweigh the
+        # ~8 ms fixed per-call cost it amortizes. Kept opt-in for hardware
+        # where the fixed cost is real (remote/tunneled accelerators).
+        if os.environ.get("BENCH_MUX", "0") == "1":
+            from txflow_tpu.verifier import VerifierMux
+
+            shared_verifier = VerifierMux(
+                shared_verifier,
+                max_batch_per_caller=bucket,
+                gather_wait=float(os.environ.get("BENCH_MUX_WAIT", "0.02")),
+            )
+            shared_verifier.start()
     else:
         priv_vals = None
 
@@ -337,7 +366,12 @@ def run_bench(platform: str) -> dict:
     if with_consensus:
         result["consensus"] = True
         result["block_height"] = max(n.block_store.height() for n in net.nodes)
-    net.stop()
+    if shared_verifier is not None and hasattr(shared_verifier, "stop"):
+        result["verifier_mux"] = True
+        net.stop()
+        shared_verifier.stop()
+    else:
+        net.stop()
     return result
 
 

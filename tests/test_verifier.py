@@ -147,3 +147,87 @@ def test_bucket_size():
     assert bucket_size(65) == 256
     assert bucket_size(70000, multiple=8) == 70000
     assert bucket_size(70001, multiple=8) == 70008
+
+
+def test_verifier_mux_matches_direct_calls():
+    """Concurrent verify calls through the mux must return bit-identical
+    results to direct per-caller calls (votes merged, slot ranges shifted,
+    results split)."""
+    import threading
+
+    from txflow_tpu.verifier import VerifierMux
+
+    vals, seeds = make_valset(4)
+    direct = ScalarVoteVerifier(vals)
+    mux = VerifierMux(ScalarVoteVerifier(vals), gather_wait=0.05)
+    mux.start()
+    try:
+        reqs = []
+        for t in range(3):  # three "engines" with different batch shapes
+            msgs, sigs, vidx, slot = make_batch(
+                vals, seeds, n_txs=2 + t, corrupt=("ok", "flip") if t == 1 else ()
+            )
+            reqs.append((msgs, sigs, vidx, slot, 2 + t))
+        want = [
+            direct.verify_and_tally(m, s, v, sl, ns) for m, s, v, sl, ns in reqs
+        ]
+        got = [None] * len(reqs)
+        errs = []
+
+        def call(i):
+            m, s, v, sl, ns = reqs[i]
+            try:
+                got[i] = mux.verify_and_tally(m, s, v, sl, ns)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(len(reqs))]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30)
+        assert not errs, errs
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w.valid, g.valid)
+            np.testing.assert_array_equal(w.stake, g.stake)
+            np.testing.assert_array_equal(w.maj23, g.maj23)
+            np.testing.assert_array_equal(w.dropped, g.dropped)
+
+        # quorum overrides are not mergeable
+        m, s, v, sl, ns = reqs[0]
+        with pytest.raises(ValueError):
+            mux.verify_and_tally(m, s, v, sl, ns, quorum=1)
+    finally:
+        mux.stop()
+
+
+def test_verifier_mux_prior_stake_isolated():
+    """Each caller's prior_stake must only affect its own slots."""
+    from txflow_tpu.verifier import VerifierMux
+
+    vals, seeds = make_valset(4)
+    mux = VerifierMux(ScalarVoteVerifier(vals), gather_wait=0.05)
+    mux.start()
+    try:
+        import threading
+
+        msgs, sigs, vidx, slot = make_batch(vals, seeds, n_txs=2)
+        # caller A: one vote shy of quorum already (prior 20 of 30 needed);
+        # caller B: zero prior — same votes, different quorum outcomes
+        prior_a = np.array([20, 0], np.int64)
+        out = {}
+
+        def call(name, prior):
+            out[name] = mux.verify_and_tally(
+                msgs[:4], sigs[:4], vidx[:4], slot[:4], 2, prior_stake=prior
+            )
+
+        ta = threading.Thread(target=call, args=("a", prior_a))
+        tb = threading.Thread(target=call, args=("b", None))
+        ta.start(); tb.start(); ta.join(30); tb.join(30)
+        # first 4 votes are tx0's full validator quorum (4 x power 10)
+        assert out["a"].stake[0] == 20 + 40 and bool(out["a"].maj23[0])
+        assert out["b"].stake[0] == 40 and bool(out["b"].maj23[0])
+        assert out["a"].stake[1] == 0 and out["b"].stake[1] == 0
+    finally:
+        mux.stop()

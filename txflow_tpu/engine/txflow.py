@@ -537,12 +537,20 @@ class TxFlow:
                 # a constructor failure cannot leave val_set/_addr_to_idx
                 # pointing at the new epoch while the verifier still gathers
                 # the old epoch's tables (wrong results, not an error).
-                if isinstance(self.verifier, DeviceVoteVerifier):
+                from ..verifier import VerifierMux
+
+                base = self.verifier
+                if isinstance(base, VerifierMux):
+                    # a shared mux cannot follow one engine's rotation
+                    # (other callers still run the old set): detach to a
+                    # private verifier built like the mux's inner one
+                    base = base.inner
+                if isinstance(base, DeviceVoteVerifier):
                     try:
                         verifier = DeviceVoteVerifier(
                             val_set,
-                            mesh=self.verifier.mesh,
-                            buckets=self.verifier.buckets,
+                            mesh=base.mesh,
+                            buckets=base.buckets,
                         )
                     except ValueError:
                         # total power >= 2^30: int32 device tally would
