@@ -112,8 +112,15 @@ def table_select_indexed(tables_flat, idx):
     one window at a time (the materialized form measured super-linear HBM
     cost past ~16k votes on v5e, r3).
     """
+    import math
+
     E = tables_flat.shape[0]
-    if E <= 2048:
+    batch = math.prod(idx.shape) if idx.shape else 1
+    # the one-hot matmul only pays off when the batch actually fills MXU
+    # tiles; for tiny batches it also hit a pathological remote-compile
+    # path on the tunneled TPU (an 8-vote entry() program compiled for
+    # >25 minutes, r3) — small or huge-table cases take the plain gather
+    if E <= 2048 and batch >= 256:
         onehot = (
             idx[..., None] == jnp.arange(E, dtype=jnp.int32)
         ).astype(jnp.bfloat16)
