@@ -1,0 +1,55 @@
+"""WAL inspection tool (reference consensus/replay_file.go analog)."""
+
+import conftest  # noqa: F401
+
+import io
+import sys
+
+from txflow_tpu.consensus.ticker import TimeoutInfo
+from txflow_tpu.consensus.types import Proposal
+from txflow_tpu.consensus.wal import ConsensusWAL
+from txflow_tpu.tools import wal_replay
+from txflow_tpu.types.block_vote import BlockVote
+
+
+def _write_sample(path):
+    w = ConsensusWAL(str(path))
+    w.write_timeout(TimeoutInfo(duration=0.1, height=1, round=0, step=1))
+    w.write_proposal(
+        Proposal(height=1, round=0, pol_round=-1, block_hash=b"\x01" * 32,
+                 timestamp_ns=1, signature=b"\x02" * 64),
+        None,
+    )
+    w.write_vote(
+        BlockVote(height=1, round=0, type=1, block_id=b"\x01" * 32,
+                  timestamp_ns=2, validator_address=b"\x03" * 20,
+                  signature=b"\x04" * 64)
+    )
+    w.write_end_height(1)
+    w.write_timeout(TimeoutInfo(duration=0.1, height=2, round=0, step=1))
+    w.close()
+
+
+def test_read_and_summarize(tmp_path):
+    path = tmp_path / "cons.wal"
+    _write_sample(path)
+    frames = wal_replay.read_wal(str(path))
+    assert [f["t"] for f in frames] == [
+        "timeout", "proposal", "vote", "end_height", "timeout",
+    ]
+    assert frames[1]["height"] == 1 and frames[1]["has_block"] is False
+    summary = wal_replay.summarize(str(path))
+    assert summary[1] == {"proposals": 1, "votes": 1, "timeouts": 1, "ended": True}
+    assert summary[2]["ended"] is False
+
+
+def test_cli_output(tmp_path, capsys):
+    path = tmp_path / "cons.wal"
+    _write_sample(path)
+    assert wal_replay.main([str(path), "--limit", "2"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 2
+    assert wal_replay.main([str(path), "--summary"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 2  # heights 1 and 2
+    assert wal_replay.main([]) == 2

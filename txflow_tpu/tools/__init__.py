@@ -1,0 +1,1 @@
+"""Operator tools (reference consensus/replay_file.go and friends)."""

@@ -1,0 +1,121 @@
+"""Consensus-WAL inspection and replay (reference consensus/replay_file.go).
+
+The reference ships an interactive console that re-feeds a consensus WAL
+into a fresh state machine for post-mortem debugging (replay_file.go:35-325).
+Same capability here, shaped for scripts first and a console second:
+
+- ``read_wal(path)``       -> decoded frames (dicts) in log order
+- ``summarize(path)``      -> per-height counts: proposals/votes/timeouts
+- ``python -m txflow_tpu.tools.wal_replay <wal> [--summary|--limit N]``
+  prints frames or the summary.
+
+The interactive loop of the reference (next/back/locate) falls out of
+``--limit N`` plus re-running; deliberately no cursor state to corrupt.
+Actually re-feeding frames into a live state machine is the node's crash
+catchup (consensus/state.py catchup + consensus/replay.py Handshaker) —
+what a restarting node replays is exactly the frames this tool prints.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from ..consensus.wal import decode_wal_message
+from ..utils.wal import WAL
+
+
+def read_wal(path: str) -> list[dict]:
+    """Decoded WAL frames, oldest first. Torn tails are dropped by the
+    underlying CRC WAL exactly as on node restart."""
+    wal = WAL(path)
+    out = []
+    try:
+        for raw in wal.replay():
+            kind, payload = decode_wal_message(raw)
+            if kind == "proposal":
+                p, block = payload
+                out.append(
+                    {
+                        "t": "proposal",
+                        "height": p.height,
+                        "round": p.round,
+                        "pol_round": p.pol_round,
+                        "block_hash": p.block_hash.hex()[:16],
+                        "has_block": block is not None,
+                    }
+                )
+            elif kind == "vote":
+                v = payload
+                out.append(
+                    {
+                        "t": "vote",
+                        "height": v.height,
+                        "round": v.round,
+                        "type": v.type,
+                        "validator": v.validator_address.hex()[:12],
+                    }
+                )
+            elif kind == "timeout":
+                ti = payload
+                out.append(
+                    {
+                        "t": "timeout",
+                        "height": ti.height,
+                        "round": ti.round,
+                        "step": ti.step,
+                        "duration": ti.duration,
+                    }
+                )
+            elif kind == "end_height":
+                out.append({"t": "end_height", "height": payload})
+            else:  # pragma: no cover - decode_wal_message is total today
+                out.append({"t": kind})
+    finally:
+        wal.close()
+    return out
+
+
+def summarize(path: str) -> dict:
+    """{height: {"proposals": n, "votes": n, "timeouts": n, "ended": bool}}"""
+    by_height: dict[int, dict] = {}
+
+    def row(h: int) -> dict:
+        return by_height.setdefault(
+            h, {"proposals": 0, "votes": 0, "timeouts": 0, "ended": False}
+        )
+
+    for fr in read_wal(path):
+        t = fr["t"]
+        if t == "proposal":
+            row(fr["height"])["proposals"] += 1
+        elif t == "vote":
+            row(fr["height"])["votes"] += 1
+        elif t == "timeout":
+            row(fr["height"])["timeouts"] += 1
+        elif t == "end_height":
+            row(fr["height"])["ended"] = True
+    return by_height
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: wal_replay <consensus.wal> [--summary | --limit N]")
+        return 2
+    path = argv[0]
+    if "--summary" in argv:
+        for h, row in sorted(summarize(path).items()):
+            print(json.dumps({"height": h, **row}))
+        return 0
+    frames = read_wal(path)
+    limit = None
+    if "--limit" in argv:
+        limit = int(argv[argv.index("--limit") + 1])
+    for i, fr in enumerate(frames if limit is None else frames[:limit]):
+        print(json.dumps({"i": i, **fr}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
