@@ -239,10 +239,16 @@ def run_bench(platform: str) -> dict:
     )
 
     # -- pregenerate txs + every validator's votes (untimed) --
+    # BASELINE config 4 (adversarial mix): BENCH_BYZANTINE=0.25 corrupts
+    # that fraction of validator 0's signatures; quorum still forms from
+    # the honest 3/4, the invalid votes burn verify work, and the run
+    # asserts none of them ever lands in a commit certificate.
+    byz_frac = float(os.environ.get("BENCH_BYZANTINE", "0"))
+
     def make_corpus(tag: str, count: int):
         txs = [b"%s-%d=v" % (tag.encode(), i) for i in range(count)]
         votes_by_val: list[list[TxVote]] = [[] for _ in range(n_vals)]
-        for tx in txs:
+        for t_i, tx in enumerate(txs):
             tx_key = hashlib.sha256(tx).digest()
             tx_hash = tx_key.hex().upper()
             for vi, pv in enumerate(net.priv_vals):
@@ -253,6 +259,10 @@ def run_bench(platform: str) -> dict:
                     validator_address=pv.get_address(),
                 )
                 pv.sign_tx_vote("txflow-bench", vote)
+                if vi == 0 and byz_frac > 0 and (t_i % 100) < byz_frac * 100:
+                    sig = bytearray(vote.signature)
+                    sig[7] ^= 0xFF
+                    vote.signature = bytes(sig)
                 votes_by_val[vi].append(vote)
         return txs, votes_by_val
 
@@ -366,6 +376,22 @@ def run_bench(platform: str) -> dict:
         "wall_s": round(wall, 3),
         "app_commit_interval": cfg.engine.commit_interval,
     }
+    if byz_frac > 0:
+        result["byzantine_fraction"] = byz_frac
+        byz_addr = net.priv_vals[0].get_address()
+        # corrupted votes must never appear in a certificate: validator 0's
+        # honest vote for a corrupted slot was never injected, so its
+        # address simply must be absent from those txs' certificates
+        bad = 0
+        for node in net.nodes:
+            for t_i, tx in enumerate(main_corpus[0]):
+                if (t_i % 100) < byz_frac * 100:
+                    votes = node.tx_store.load_tx_votes(
+                        hashlib.sha256(tx).hexdigest().upper()
+                    )
+                    if votes and byz_addr in {v.validator_address for v in votes}:
+                        bad += 1
+        result["byzantine_votes_in_certificates"] = bad
     if with_consensus:
         result["consensus"] = True
         result["block_height"] = max(n.block_store.height() for n in net.nodes)
