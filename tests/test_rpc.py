@@ -137,3 +137,55 @@ def test_tx_indexer_and_debug_endpoints():
         assert any("consensus" in name for name in stacks["threads"])
     finally:
         net.stop()
+
+
+def test_grpc_broadcast_api():
+    """core_grpc.BroadcastAPI analog (reference node/node.go:972-986): an
+    external gRPC client pings and broadcasts a tx; the response carries
+    the executed result after fast-path commit."""
+    import grpc
+
+    from txflow_tpu.codec import amino
+    from txflow_tpu.node import LocalNet
+    from txflow_tpu.rpc.grpc_server import (
+        GRPCBroadcastServer,
+        decode_request_broadcast_tx,
+    )
+
+    net = LocalNet(4, use_device_verifier=False)
+    net.start()
+    srv = GRPCBroadcastServer(net.nodes[0])
+    try:
+        host, port = srv.start()
+        ident = lambda b: b
+        chan = grpc.insecure_channel(f"{host}:{port}")
+        ping = chan.unary_unary(
+            "/core_grpc.BroadcastAPI/Ping",
+            request_serializer=ident, response_deserializer=ident,
+        )
+        assert ping(b"", timeout=10) == b""
+
+        tx = b"grpc-k=v"
+        req = bytes(amino.field_key(1, amino.TYP3_BYTELEN)) + bytes(
+            amino.length_prefixed(tx)
+        )
+        assert decode_request_broadcast_tx(req) == tx
+        bcast = chan.unary_unary(
+            "/core_grpc.BroadcastAPI/BroadcastTx",
+            request_serializer=ident, response_deserializer=ident,
+        )
+        resp = bcast(req, timeout=60)
+        # ResponseBroadcastTx: field 1 = check_tx (code absent => 0),
+        # field 2 = deliver_tx present on successful commit
+        r = amino.AminoReader(resp)
+        fields = {}
+        while not r.eof():
+            fnum, typ3 = r.read_field_key()
+            fields[fnum] = r.read_bytes()
+        assert 1 in fields and fields[1] == b""  # check code 0, no log
+        assert 2 in fields  # delivered
+        assert net.nodes[0].is_committed(tx)
+        chan.close()
+    finally:
+        srv.stop()
+        net.stop()

@@ -64,6 +64,9 @@ class NodeConfig:
     # tx indexer (reference TxIndexConfig "kv"/"null", node/node.go:211-238):
     # False = the "null" indexer, no per-commit index rows
     index_txs: bool = True
+    # simplified gRPC BroadcastAPI (reference node/node.go:972-986);
+    # port 0 = ephemeral (read Node.grpc.port), None = no listener
+    grpc_port: int | None = None
     # ed25519 node key seed: enables authenticated secret connections on
     # TCP links (reference p2p.LoadOrGenNodeKey, node/node.go:72)
     node_key_seed: bytes | None = None
@@ -252,6 +255,11 @@ class Node:
             from ..rpc import RPCServer
 
             self.rpc = RPCServer(self, host=nc.rpc_host, port=nc.rpc_port)
+        self.grpc = None
+        if nc.grpc_port is not None:
+            from ..rpc.grpc_server import GRPCBroadcastServer
+
+            self.grpc = GRPCBroadcastServer(self, host=nc.rpc_host, port=nc.grpc_port)
 
         self._started = False
 
@@ -314,6 +322,8 @@ class Node:
             self.consensus.start()
         if self.rpc is not None:
             self.rpc.start()
+        if self.grpc is not None:
+            self.grpc.start()
 
     def stop(self) -> None:
         if not self._started:
@@ -321,6 +331,8 @@ class Node:
         self._started = False
         if self.rpc is not None:
             self.rpc.stop()
+        if self.grpc is not None:
+            self.grpc.stop()
         if self.consensus is not None:
             self.consensus.stop()
         self.txflow.stop()

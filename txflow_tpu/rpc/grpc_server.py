@@ -1,0 +1,136 @@
+"""Simplified gRPC broadcast API (reference node/node.go:972-986).
+
+The reference exposes tendermint's ``core_grpc.BroadcastAPI`` — Ping and
+BroadcastTx — "for convenience to app devs" next to the HTTP/WS RPC. Same
+surface here: a grpcio server with hand-rolled proto3 message codecs (the
+messages are tiny and stable; no generated stubs, no protoc step):
+
+  service BroadcastAPI {             # rpc/grpc/types.proto, pkg core_grpc
+    rpc Ping(RequestPing) returns (ResponsePing)
+    rpc BroadcastTx(RequestBroadcastTx) returns (ResponseBroadcastTx)
+  }
+  message RequestBroadcastTx { bytes tx = 1 }
+  message ResponseBroadcastTx {
+    ResponseCheckTx   check_tx   = 1   # { uint32 code = 1, bytes data = 2, string log = 3 }
+    ResponseDeliverTx deliver_tx = 2   # same shape
+  }
+
+BroadcastTx here submits through the node's fast path and, like the
+reference's gRPC handler (BroadcastAPI.BroadcastTx runs CheckTx and
+DeliverTx to completion), waits for the commit so the response carries
+the executed DeliverTx result.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..codec import amino
+
+_SERVICE = "core_grpc.BroadcastAPI"
+
+
+def _field(fnum: int, typ3: int, payload: bytes) -> bytes:
+    return bytes(amino.field_key(fnum, typ3)) + payload
+
+
+def encode_check_deliver(code: int, data: bytes, log: str) -> bytes:
+    """proto3 body shared by ResponseCheckTx / ResponseDeliverTx."""
+    out = bytearray()
+    if code:
+        out += _field(1, amino.TYP3_VARINT, amino.uvarint(code))
+    if data:
+        out += _field(2, amino.TYP3_BYTELEN, amino.length_prefixed(data))
+    if log:
+        out += _field(3, amino.TYP3_BYTELEN, amino.length_prefixed(log.encode()))
+    return bytes(out)
+
+
+def decode_request_broadcast_tx(body: bytes) -> bytes:
+    r = amino.AminoReader(body)
+    tx = b""
+    while not r.eof():
+        fnum, typ3 = r.read_field_key()
+        if fnum == 1 and typ3 == amino.TYP3_BYTELEN:
+            tx = r.read_bytes()
+        else:
+            r.skip_field(typ3)
+    return tx
+
+
+class GRPCBroadcastServer:
+    """grpcio server wrapping a Node; start() binds an ephemeral port."""
+
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+        self.node = node
+        self.host = host
+        self.port = port
+        self._server = None
+
+    # -- handlers --
+
+    def _ping(self, request: bytes, context) -> bytes:
+        return b""  # ResponsePing{}
+
+    def _broadcast_tx(self, request: bytes, context) -> bytes:
+        tx = decode_request_broadcast_tx(request)
+        check_code, check_log = 0, ""
+        try:
+            self.node.broadcast_tx(tx)
+        except Exception as e:
+            check_code, check_log = 1, str(e)
+        delivered = False
+        if check_code == 0:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and not self.node.is_committed(tx):
+                time.sleep(0.02)
+            if self.node.is_committed(tx):
+                delivered = True
+            else:
+                check_code, check_log = 1, "commit timeout"
+        check = encode_check_deliver(check_code, b"", check_log)
+        out = bytearray()
+        out += _field(1, amino.TYP3_BYTELEN, amino.length_prefixed(check))
+        if delivered:
+            # a clean DeliverTx (code 0, no data/log) encodes to an EMPTY
+            # proto3 body — the field must still be present on success
+            out += _field(
+                2, amino.TYP3_BYTELEN,
+                amino.length_prefixed(encode_check_deliver(0, b"", "")),
+            )
+        return bytes(out)
+
+    # -- lifecycle --
+
+    def start(self) -> tuple[str, int]:
+        import grpc
+
+        outer = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, details):
+                ident = lambda b: b  # raw-bytes (de)serializers
+                if details.method == f"/{_SERVICE}/Ping":
+                    return grpc.unary_unary_rpc_method_handler(
+                        outer._ping, request_deserializer=ident,
+                        response_serializer=ident,
+                    )
+                if details.method == f"/{_SERVICE}/BroadcastTx":
+                    return grpc.unary_unary_rpc_method_handler(
+                        outer._broadcast_tx, request_deserializer=ident,
+                        response_serializer=ident,
+                    )
+                return None
+
+        from concurrent import futures
+
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        self._server.start()
+        return self.host, self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1)
+            self._server = None
