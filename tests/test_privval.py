@@ -173,3 +173,19 @@ def test_localnet_runs_on_file_pvs(tmp_path):
             assert pv.last_height >= 1
     finally:
         net.stop()
+
+
+def test_node_greeting_sign_and_verify():
+    """Node identity greeting (reference node/id.go — vestigial there,
+    implemented here): signed greeting verifies, tampered does not."""
+    import hashlib
+
+    from txflow_tpu.crypto import ed25519
+    from txflow_tpu.node.id import NodeID, PrivNodeID
+
+    seed = hashlib.sha256(b"nid").digest()
+    nid = NodeID("n0", ed25519.public_key_from_seed(seed))
+    sg = PrivNodeID(nid, seed).sign_greeting("0.3.0", "txflow-test", "hi")
+    assert sg.verify()
+    sg.greeting.message = "tampered"
+    assert not sg.verify()
