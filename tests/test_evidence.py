@@ -168,3 +168,135 @@ def test_byzantine_double_block_vote_captured_and_gossiped():
         assert ev.validator_address == byz_pv.get_address()
     finally:
         net.stop()
+
+
+def test_evidence_commits_into_blocks_and_drains_pools():
+    """The full loop the reference wires via the evidence pool + blocks
+    (state/execution.go:103 reaps PendingEvidence; ApplyBlock marks it
+    committed): captured equivocation is proposed inside a block, the
+    block header commits to it (EvidenceHash), every node's app sees the
+    byzantine validator in BeginBlock, and all pools stop gossiping it."""
+    from txflow_tpu.utils.config import test_config as make_test_config
+
+    cfg = make_test_config()
+    cfg.consensus.skip_timeout_commit = True
+    net = LocalNet(
+        4, use_device_verifier=False, enable_consensus=True, config=cfg
+    )
+    seen_byzantine = [set() for _ in net.nodes]
+    for i, node in enumerate(net.nodes):
+        orig = node.app.begin_block
+
+        def hook(req, _orig=orig, _seen=seen_byzantine[i]):
+            for addr, h in req.byzantine_validators:
+                _seen.add(addr)
+            return _orig(req)
+
+        node.app.begin_block = hook
+    net.start()
+    try:
+        byz_pv = net.priv_vals[0]
+        cs = net.nodes[1].consensus
+
+        def inject_conflicts():
+            rs = cs.round_state()
+            for block_id in (b"\x0c" * 32, b"\x0d" * 32):
+                v = BlockVote(
+                    height=rs.height,
+                    round=rs.round,
+                    type=PREVOTE,
+                    block_id=block_id,
+                    validator_address=byz_pv.get_address(),
+                )
+                byz_pv.sign_block_vote(net.chain_id, v)
+                cs.add_vote(v, peer_id="byz")
+            return net.nodes[1].evidence_pool.size() >= 1
+
+        assert wait_until(inject_conflicts, timeout=30, poll=0.05)
+        # a later block must carry the evidence and commit it everywhere
+        assert wait_until(
+            lambda: all(
+                byz_pv.get_address() in seen for seen in seen_byzantine
+            ),
+            timeout=60,
+        ), "every node's app must see the byzantine validator via BeginBlock"
+        assert wait_until(
+            lambda: all(n.evidence_pool.size() == 0 for n in net.nodes),
+            timeout=30,
+        ), "committed evidence must drain from every pool"
+        # the stored block carries it, hash-committed
+        store = net.nodes[2].block_store
+        found = None
+        for h in range(1, store.height() + 1):
+            blk = store.load_block(h)
+            if blk is not None and blk.evidence:
+                found = blk
+                break
+        assert found is not None, "no stored block carries the evidence"
+        from txflow_tpu.types.block import evidence_root
+
+        assert found.header.evidence_hash == evidence_root(found.evidence)
+        assert found.evidence[0].validator_address == byz_pv.get_address()
+    finally:
+        net.stop()
+
+
+def test_proposal_filters_unusable_evidence_and_validation_rejects_recommit():
+    """(a) Proposals exclude evidence a block could not validate (future
+    height, validator no longer in the set) so a proposer can never wedge
+    itself; (b) validation rejects evidence that already committed, so one
+    offense cannot be punished twice (r3 review findings)."""
+    from txflow_tpu.abci.kvstore import KVStoreApplication
+    from txflow_tpu.abci.proxy import AppConns
+    from txflow_tpu.pool.mempool import Mempool
+    from txflow_tpu.state.execution import BlockExecutor
+    from txflow_tpu.state.state import state_from_genesis
+    from txflow_tpu.state.store import StateStore
+    from txflow_tpu.store.db import MemDB
+    from txflow_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from txflow_tpu.utils.config import test_config as make_test_config
+
+    vs, pvs = make_valset(4)
+    gen = GenesisDoc(
+        chain_id=CHAIN_ID,
+        validators=[GenesisValidator(v.pub_key, v.voting_power) for v in vs],
+    )
+    state = state_from_genesis(gen)
+    proxy = AppConns(KVStoreApplication())
+    pool = EvidencePool(CHAIN_ID, lambda: vs)
+    exec_ = BlockExecutor(
+        StateStore(MemDB()), proxy.consensus,
+        Mempool(make_test_config().mempool, proxy_app_conn=proxy.mempool),
+        Mempool(make_test_config().mempool),
+        evidence_pool=pool,
+    )
+
+    def equivocation(pv, height):
+        votes = []
+        for bid in (b"\x0e" * 32, b"\x0f" * 32):
+            v = BlockVote(height=height, round=0, type=PREVOTE, block_id=bid,
+                          validator_address=pv.get_address())
+            pv.sign_block_vote(CHAIN_ID, v)
+            votes.append(v)
+        return DuplicateBlockVoteEvidence(*votes)
+
+    good = equivocation(pvs[0], 1)
+    future = equivocation(pvs[1], 999)  # far beyond the next height
+    outsider_pv = MockPV(hashlib.sha256(b"gone").digest())
+    unknown = equivocation(outsider_pv, 1)
+    assert pool.add(good)[0]
+    assert pool.add(future)[0]
+    pool._pending[unknown.hash()] = unknown  # bypass: "was valid, then left"
+
+    block = exec_.create_proposal_block(1, state, None, vs.get_by_index(0).address)
+    assert [ev.hash() for ev in block.evidence] == [good.hash()]
+    assert not pool.has(unknown) or unknown.hash() not in pool._pending
+
+    # the proposed block validates...
+    assert exec_.validate_block(state, block) is None
+    # ...but once its evidence is committed, re-proposing it is rejected
+    pool.mark_committed([good])
+    block2 = state.make_block(1, [], [], None, vs.get_by_index(0).address,
+                              evidence=[good])
+    err = exec_.validate_block(state, block2)
+    assert err == "evidence already committed", err

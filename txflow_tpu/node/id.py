@@ -30,16 +30,22 @@ class NodeGreeting:
     time_ns: int = field(default_factory=_time.time_ns)
 
     def sign_bytes(self) -> bytes:
-        return "|".join(
-            [
-                self.node_id.name,
-                self.node_id.pub_key.hex(),
-                self.version,
-                self.chain_id,
-                self.message,
-                str(self.time_ns),
-            ]
-        ).encode()
+        # length-prefixed fields: free-form strings must not be able to
+        # shift bytes across field boundaries (a '|' join would let
+        # version='a|b' collide with chain_id-shifted variants)
+        from ..codec import amino
+
+        out = bytearray()
+        for f in (
+            self.node_id.name.encode(),
+            self.node_id.pub_key,
+            self.version.encode(),
+            self.chain_id.encode(),
+            self.message.encode(),
+            str(self.time_ns).encode(),
+        ):
+            out += amino.length_prefixed(f)
+        return bytes(out)
 
 
 @dataclass

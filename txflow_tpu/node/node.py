@@ -225,6 +225,7 @@ class Node:
             self.mempool,
             self.commitpool,
             event_bus=self.event_bus,
+            evidence_pool=self.evidence_pool,
         )
         self.consensus: ConsensusState | None = None
         self.consensus_reactor: ConsensusReactor | None = None
@@ -286,6 +287,10 @@ class Node:
         via shared state). Vtx double-apply protection lives in the
         claim_vtx wiring, exercised during apply_block itself."""
         self.chain_state = new_state
+        if block is not None and block.evidence:
+            # committed proofs stop gossiping/pending on EVERY node
+            # (reference evpool.Update inside ApplyBlock)
+            self.evidence_pool.mark_committed(block.evidence)
         self.update_state(new_state.last_block_height, new_state.validators)
 
     # -- lifecycle (reference OnStart :768-826 / OnStop :829-874) --

@@ -69,6 +69,16 @@ class EvidencePool:
         with self._mtx:
             return h in self._pending or h in self._committed
 
+    def is_committed(self, ev) -> bool:
+        with self._mtx:
+            return ev.hash() in self._committed
+
+    def drop(self, ev) -> None:
+        """Remove evidence that turned out unusable (e.g. its validator
+        left the set before it could be proposed)."""
+        with self._mtx:
+            self._pending.pop(ev.hash(), None)
+
     def mark_committed(self, evs: list) -> None:
         """Evidence landed on-chain (or was otherwise handled): stop
         gossiping it but remember it so it cannot be re-admitted."""

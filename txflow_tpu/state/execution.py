@@ -110,7 +110,26 @@ class BlockExecutor:
             # blocks as Vtxs once committed (see is_tx_reserved)
             txs = [tx for tx in txs if not self.tx_reserved(tx)]
         vtxs = self.commitpool.reap_max_txs(-1)  # ALL fast-path commits
-        return state.make_block(height, txs, vtxs, last_commit, proposer_address)
+        # only evidence the block will VALIDATE may be proposed: pool
+        # admission checked against the valset of its arrival time, and a
+        # since-removed validator or a future-height proof would make this
+        # proposer's every block invalid forever (r3 review). Unusable
+        # evidence is dropped from the pool so it cannot wedge proposals.
+        evidence = []
+        if self.evidence_pool is not None:
+            for ev in self.evidence_pool.pending():
+                _, val = state.validators.get_by_address(ev.validator_address)
+                if (
+                    0 < ev.height() <= height
+                    and val is not None
+                    and ev.verify(state.chain_id, val.pub_key) is None
+                ):
+                    evidence.append(ev)
+                elif val is None:
+                    self.evidence_pool.drop(ev)
+        return state.make_block(
+            height, txs, vtxs, last_commit, proposer_address, evidence=evidence
+        )
 
     # -- validation (reference state/validation.go:18-168) --
 
@@ -140,6 +159,38 @@ class BlockExecutor:
             return "wrong NextValidatorsHash"
         if not state.validators.has_address(h.proposer_address):
             return "proposer is not in the validator set"
+        # evidence: hash commitment + every proof verifies against a known
+        # validator at a plausible height (reference state/validation.go
+        # evidence section; the pool re-verifies on gossip, this re-checks
+        # at commit so a byzantine proposer cannot smuggle junk)
+        from ..types.block import evidence_root
+
+        if block.evidence:
+            if h.evidence_hash != evidence_root(block.evidence):
+                return "wrong EvidenceHash"
+            seen_ev = set()
+            for ev in block.evidence:
+                k = ev.hash()
+                if k in seen_ev:
+                    return "duplicate evidence in block"
+                seen_ev.add(k)
+                if self.evidence_pool is not None and self.evidence_pool.is_committed(ev):
+                    # one offense, one punishment: a byzantine proposer
+                    # re-including already-committed evidence must not make
+                    # the app see the validator as byzantine twice (the
+                    # committed set is in-memory; after a restart the
+                    # handshake replays committed blocks, which re-marks it)
+                    return "evidence already committed"
+                if not (0 < ev.height() <= h.height):
+                    return "evidence from an impossible height"
+                _, val = state.validators.get_by_address(ev.validator_address)
+                if val is None:
+                    return "evidence names an unknown validator"
+                ev_err = ev.verify(state.chain_id, val.pub_key)
+                if ev_err:
+                    return f"invalid evidence: {ev_err}"
+        elif h.evidence_hash:
+            return "wrong EvidenceHash"
         if h.height == 1:
             if block.last_commit is not None and block.last_commit.precommits:
                 return "block at height 1 can't have LastCommit precommits"
@@ -218,6 +269,12 @@ class BlockExecutor:
                 hash=block.hash(),
                 height=block.height,
                 proposer_address=block.header.proposer_address,
+                # committed equivocation proofs surface to the app like the
+                # reference's ByzantineValidators (state/execution.go
+                # BeginBlock request)
+                byzantine_validators=[
+                    (ev.validator_address, ev.height()) for ev in block.evidence
+                ],
             )
         )
         if vtx_filter is not None:

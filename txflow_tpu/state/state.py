@@ -90,6 +90,7 @@ class State:
         last_commit: BlockCommit | None,
         proposer_address: bytes,
         time_ns: int | None = None,
+        evidence: list | None = None,
     ) -> Block:
         header = Header(
             chain_id=self.chain_id,
@@ -104,7 +105,22 @@ class State:
             last_results_hash=self.last_results_hash,
             proposer_address=proposer_address,
         )
-        block = Block(header=header, data=Data(txs=txs, vtxs=vtxs), last_commit=last_commit)
+        if last_commit is not None:
+            # SNAPSHOT the commit: consensus extends its live seen-commit in
+            # place when late precommits arrive (_extend_last_commit, for
+            # commit-gossip liveness) — a block aliasing that object would
+            # have its LastCommitHash drift after the header was hashed
+            # (observed as "wrong Header.LastCommitHash" at finalize under
+            # block churn, r3)
+            last_commit = BlockCommit(
+                last_commit.block_id, list(last_commit.precommits)
+            )
+        block = Block(
+            header=header,
+            data=Data(txs=txs, vtxs=vtxs),
+            last_commit=last_commit,
+            evidence=list(evidence or []),
+        )
         block.fill_header()
         return block
 

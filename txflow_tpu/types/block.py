@@ -81,6 +81,7 @@ class Header:
     app_hash: bytes = b""
     last_results_hash: bytes = b""
     proposer_address: bytes = b""
+    evidence_hash: bytes = b""  # empty when the block carries no evidence
 
     def hash(self) -> bytes:
         """Block hash = sha256 of the deterministic header encoding."""
@@ -92,6 +93,9 @@ class Block:
     header: Header = field(default_factory=Header)
     data: Data = field(default_factory=Data)
     last_commit: BlockCommit | None = None
+    # committed equivocation proofs (reference block.Evidence; reaped from
+    # the evidence pool into proposals, state/execution.go:103)
+    evidence: list = field(default_factory=list)
 
     @property
     def height(self) -> int:
@@ -114,6 +118,8 @@ class Block:
             self.header.data_hash = self.data.hash()
         if not self.header.last_commit_hash and self.last_commit is not None:
             self.header.last_commit_hash = self.last_commit.hash()
+        if not self.header.evidence_hash and self.evidence:
+            self.header.evidence_hash = evidence_root(self.evidence)
 
     def validate_basic(self) -> str | None:
         """Internal consistency only (reference Block.ValidateBasic)."""
@@ -183,6 +189,8 @@ def encode_header(h: Header) -> bytes:
     bfield(11, h.app_hash)
     bfield(12, h.last_results_hash)
     bfield(13, h.proposer_address)
+    bfield(14, h.evidence_hash)  # elided when empty: evidence-free blocks
+    # hash identically to pre-evidence encodings
     return bytes(body)
 
 
@@ -192,6 +200,7 @@ _HEADER_BYTES_FIELDS = {
     7: "last_commit_hash",
     8: "data_hash",
     9: "validators_hash",
+    14: "evidence_hash",
     10: "next_validators_hash",
     11: "app_hash",
     12: "last_results_hash",
@@ -228,7 +237,15 @@ def _decode_tx_list(r: amino.AminoReader) -> list[bytes]:
     return [r.read_bytes() for _ in range(n)]
 
 
+def evidence_root(evs: list) -> bytes:
+    from .evidence import encode_evidence
+
+    return merkle_root([encode_evidence(ev) for ev in evs])
+
+
 def encode_block(b: Block) -> bytes:
+    from .evidence import encode_evidence
+
     body = bytearray()
     body.extend(amino.field_key(1, amino.TYP3_BYTELEN))
     body.extend(amino.length_prefixed(encode_header(b.header)))
@@ -239,6 +256,9 @@ def encode_block(b: Block) -> bytes:
     if b.last_commit is not None:
         body.extend(amino.field_key(4, amino.TYP3_BYTELEN))
         body.extend(amino.length_prefixed(encode_block_commit(b.last_commit)))
+    for ev in b.evidence:
+        body.extend(amino.field_key(5, amino.TYP3_BYTELEN))
+        body.extend(amino.length_prefixed(encode_evidence(ev)))
     return bytes(body)
 
 
@@ -255,6 +275,10 @@ def decode_block(data: bytes) -> Block:
             b.data.vtxs = _decode_tx_list(amino.AminoReader(r.read_bytes()))
         elif fnum == 4 and typ3 == amino.TYP3_BYTELEN:
             b.last_commit = decode_block_commit(r.read_bytes())
+        elif fnum == 5 and typ3 == amino.TYP3_BYTELEN:
+            from .evidence import decode_evidence
+
+            b.evidence.append(decode_evidence(r.read_bytes()))
         else:
             r.skip_field(typ3)
     return b
