@@ -189,3 +189,90 @@ def test_grpc_broadcast_api():
     finally:
         srv.stop()
         net.stop()
+
+
+def test_websocket_event_stream():
+    """RFC 6455 WS subscription (reference WS RPC, node/node.go:914-922):
+    a raw-socket client upgrades, subscribes to Tx events, and receives a
+    commit event as a JSON text frame."""
+    import base64
+    import hashlib as _hl
+    import socket
+    import struct
+
+    from txflow_tpu.node import LocalNet
+
+    net = LocalNet(4, use_device_verifier=False, rpc=True)
+    net.start()
+    try:
+        host, port = net.nodes[0].rpc.addr
+        s = socket.create_connection((host, port), timeout=30)
+        key = base64.b64encode(b"0123456789abcdef").decode()
+        s.sendall(
+            (
+                f"GET /websocket HTTP/1.1\r\nHost: {host}\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\n"
+                "Sec-WebSocket-Version: 13\r\n\r\n"
+            ).encode()
+        )
+        # read the 101 response headers
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            buf += s.recv(1024)
+        head = buf.split(b"\r\n\r\n", 1)[0].decode()
+        assert "101" in head.splitlines()[0]
+        want = base64.b64encode(
+            _hl.sha1(
+                (key + "258EAFA5-E914-47DA-95CA-C5AB0DC85B11").encode()
+            ).digest()
+        ).decode()
+        assert want in head
+        rest = buf.split(b"\r\n\r\n", 1)[1]
+
+        def send_text(payload: bytes):
+            mask = b"\x01\x02\x03\x04"
+            masked = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+            s.sendall(bytes([0x81, 0x80 | len(payload)]) + mask + masked)
+
+        recv_buf = [rest]
+
+        def read_exact(n):
+            out = b""
+            while len(out) < n:
+                if recv_buf[0]:
+                    take = recv_buf[0][: n - len(out)]
+                    recv_buf[0] = recv_buf[0][len(take):]
+                    out += take
+                else:
+                    recv_buf[0] = s.recv(4096)
+                    if not recv_buf[0]:
+                        raise ConnectionError("closed")
+            return out
+
+        def read_frame():
+            b0, b1 = read_exact(2)
+            n = b1 & 0x7F
+            if n == 126:
+                (n,) = struct.unpack(">H", read_exact(2))
+            return b0 & 0x0F, read_exact(n)
+
+        send_text(b'{"subscribe": "Tx"}')
+        op, data = read_frame()
+        assert op == 1 and json.loads(data)["subscribed"] == "Tx"
+
+        tx = b"ws-k=v"
+        net.broadcast_tx(tx)
+        tx_hash = hashlib.sha256(tx).hexdigest().upper()
+        deadline = time.time() + 60
+        seen = False
+        while time.time() < deadline and not seen:
+            op, data = read_frame()
+            if op == 1:
+                ev = json.loads(data)
+                if ev.get("hash") == tx_hash:
+                    seen = True
+        assert seen, "commit event must stream over the websocket"
+        s.close()
+    finally:
+        net.stop()

@@ -40,6 +40,26 @@ def _parse_tx_param(raw: str) -> bytes:
     return raw.encode()
 
 
+def _event_json(ev) -> dict:
+    """JSON-safe projection of an event-bus payload for WS streaming."""
+    d = ev.data
+    if hasattr(d, "tx_hash"):
+        return {
+            "type": ev.type,
+            "height": d.height,
+            "hash": d.tx_hash,
+            "code": d.result_code,
+        }
+    blk = getattr(d, "block", None)
+    if blk is not None:
+        return {
+            "type": ev.type,
+            "height": blk.height,
+            "hash": blk.hash().hex().upper(),
+        }
+    return {"type": ev.type}
+
+
 class RPCServer:
     def __init__(self, node, host: str = "127.0.0.1", port: int = 0, debug=None):
         """debug: expose /debug/* hooks. Default: only on loopback binds —
@@ -92,6 +112,12 @@ class RPCServer:
                         for k, v in urllib.parse.parse_qs(parsed.query).items()
                     }
                     route = parsed.path.rstrip("/") or "/"
+                    if route == "/websocket":
+                        # event-stream upgrade (reference WS subscriptions,
+                        # node/node.go:914-922); takes over the socket
+                        rpc._serve_websocket(self)
+                        self.close_connection = True
+                        return
                     handler = rpc._routes.get(route)
                     if handler is None:
                         self._reply({"error": f"unknown path {route}"}, 404)
@@ -186,6 +212,100 @@ class RPCServer:
             "votes": len(votes) if votes else 0,
             "has_commit_cert": commit is not None,
         }
+
+    # -- WebSocket event streaming (RFC 6455 server side, no deps) --
+
+    _WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+    def _serve_websocket(self, handler) -> None:
+        """Upgrade + event pump. Client subscribes with one JSON text
+        frame {"subscribe": "Tx" | "NewBlock"}; the server then streams
+        each matching event as a JSON text frame until the client closes.
+        The reference serves the same capability via its WS RPC
+        subscriptions (node/node.go:914-922)."""
+        import base64
+        import hashlib as _hl
+        import struct as _st
+
+        key = handler.headers.get("Sec-WebSocket-Key", "")
+        if handler.headers.get("Upgrade", "").lower() != "websocket" or not key:
+            handler.send_response(400)
+            handler.end_headers()
+            return
+        accept = base64.b64encode(
+            _hl.sha1((key + self._WS_GUID).encode()).digest()
+        ).decode()
+        handler.wfile.write(
+            (
+                "HTTP/1.1 101 Switching Protocols\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Accept: {accept}\r\n\r\n"
+            ).encode()
+        )
+        handler.wfile.flush()
+        rf, wf = handler.rfile, handler.wfile
+
+        def send_frame(opcode: int, payload: bytes) -> None:
+            hdr = bytes([0x80 | opcode])
+            n = len(payload)
+            if n < 126:
+                hdr += bytes([n])
+            elif n < 1 << 16:
+                hdr += bytes([126]) + _st.pack(">H", n)
+            else:
+                hdr += bytes([127]) + _st.pack(">Q", n)
+            wf.write(hdr + payload)
+            wf.flush()
+
+        def recv_frame():
+            b0 = rf.read(1)
+            if not b0:
+                return None, b""
+            opcode = b0[0] & 0x0F
+            b1 = rf.read(1)[0]
+            n = b1 & 0x7F
+            if n == 126:
+                (n,) = _st.unpack(">H", rf.read(2))
+            elif n == 127:
+                (n,) = _st.unpack(">Q", rf.read(8))
+            mask = rf.read(4) if b1 & 0x80 else b""  # clients MUST mask
+            data = rf.read(n)
+            if mask:
+                data = bytes(c ^ mask[i % 4] for i, c in enumerate(data))
+            return opcode, data
+
+        try:
+            opcode, data = recv_frame()
+            if opcode != 1:  # expect a text subscribe frame
+                send_frame(8, b"")
+                return
+            req = json.loads(data or b"{}")
+            event_type = req.get("subscribe", "Tx")
+            if event_type not in ("Tx", "NewBlock"):
+                send_frame(1, json.dumps({"error": "unknown event"}).encode())
+                send_frame(8, b"")
+                return
+            sub = self.node.event_bus.subscribe(event_type)
+            send_frame(1, json.dumps({"subscribed": event_type}).encode())
+            try:
+                handler.connection.settimeout(0.5)
+                while True:
+                    ev = sub.get(timeout=0.5)
+                    if ev is not None:
+                        send_frame(1, json.dumps(_event_json(ev)).encode())
+                    # poll for a client close/ping between events
+                    try:
+                        opcode, data = recv_frame()
+                    except (TimeoutError, OSError):
+                        continue
+                    if opcode is None or opcode == 8:  # closed
+                        return
+                    if opcode == 9:  # ping -> pong
+                        send_frame(10, data)
+            finally:
+                self.node.event_bus.unsubscribe(event_type, sub)
+        except (BrokenPipeError, ConnectionError, OSError):
+            pass
 
     def _subscribe_tx(self, q: dict) -> dict:
         """Long-poll tx-commit subscription (the WS subscribe analog:
