@@ -231,3 +231,45 @@ def test_verifier_mux_prior_stake_isolated():
         assert out["a"].stake[1] == 0 and out["b"].stake[1] == 0
     finally:
         mux.stop()
+
+
+def test_ring_tally_matches_psum_step():
+    """The explicit ppermute ring all-reduce must produce bit-identical
+    tallies to the psum formulation over the virtual mesh."""
+    import numpy as _np
+
+    from txflow_tpu.ops import ed25519_batch
+    from txflow_tpu.parallel import make_mesh
+    from txflow_tpu.parallel.mesh import sharded_compact_step, sharded_ring_step
+
+    vals, seeds = make_valset(4)
+    epoch = ed25519_batch.EpochTables([v.pub_key for v in vals])
+    msgs, sigs, vidx, slot = make_batch(
+        vals, seeds, n_txs=4, corrupt=("ok", "ok", "flip")
+    )
+    batch = ed25519_batch.prepare_compact(msgs, sigs, vidx, epoch)
+    n = batch.size
+    pad = (-n) % 8
+    import numpy as np
+
+    def p(a):
+        return np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+
+    args = (
+        p(batch.s_nibbles), p(batch.h_nibbles), p(batch.val_idx),
+        p(batch.r_y), p(batch.r_sign), p(batch.pre_ok),
+        np.concatenate([np.asarray(slot, np.int32), np.full(pad, -1, np.int32)]),
+        epoch.tables, vals.powers_array().astype(np.int32),
+        np.zeros(4, np.int32), np.int32(vals.quorum_power()),
+    )
+    mesh = make_mesh(8)
+    a = sharded_compact_step(mesh)(*args)
+    b = sharded_ring_step(mesh)(*args)
+    _np.testing.assert_array_equal(_np.asarray(a[0]), _np.asarray(b[0]))
+    # ring outputs are per-shard copies of the global: every shard's slice
+    # must equal the psum-replicated global
+    stake = _np.asarray(b[1]).reshape(8, -1)
+    maj = _np.asarray(b[2]).reshape(8, -1)
+    for sh in range(8):
+        _np.testing.assert_array_equal(stake[sh], _np.asarray(a[1]))
+        _np.testing.assert_array_equal(maj[sh], _np.asarray(a[2]))

@@ -110,3 +110,67 @@ def sharded_compact_step_packed_cached(mesh: Mesh, axis_name: str = VOTE_AXIS):
         out_specs=v,
     )
     return jax.jit(f)
+
+
+def ring_tally(stake_partial, axis_name: str = VOTE_AXIS):
+    """All-reduce a per-shard partial stake tally around the ICI ring.
+
+    The ``psum`` the compact step uses lets XLA pick the collective; this
+    is the explicit ring formulation (the ring-attention analog for the
+    vote axis): N-1 ``ppermute`` rotations, each shard accumulating its
+    neighbor's partial, after which every shard holds the global tally.
+    Useful when the tally should overlap with other per-shard work on
+    real ICI (XLA schedules each hop independently) and as the pattern
+    template for future ring-style kernels.
+    """
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop(_, carry):
+        rotating, total = carry
+        rotating = jax.lax.ppermute(rotating, axis_name, perm)
+        return rotating, total + rotating
+
+    _, total = jax.lax.fori_loop(
+        0, n - 1, hop, (stake_partial, stake_partial)
+    )
+    return total
+
+
+def sharded_ring_step(mesh: Mesh, axis_name: str = VOTE_AXIS):
+    """Compact fused step with the ring all-reduce instead of psum.
+
+    Bit-identical tallies to ``sharded_compact_step`` (integer addition is
+    associative/commutative and every shard contributes exactly once) —
+    pinned by tests/test_verifier.py's mesh parity test.
+
+    Output layout difference, for honesty with the static VMA checker: a
+    ppermute chain does not PROVE replication the way psum does, so the
+    stake/maj outputs are declared per-shard — shape [n_shards * S], each
+    shard's identical copy of the global concatenated; take shard 0's
+    slice. (The checker stays ON; suppressing it was round-2 review
+    finding #7 and is not coming back.)
+    """
+    from ..ops import ed25519_batch
+
+    def inner(s_nib, h_nib, val_idx, r_y, r_sign, pre_ok, tx_slot,
+              tables, powers, prior_stake, quorum):
+        valid = ed25519_batch.verify_kernel_gather(
+            s_nib, h_nib, val_idx, tables, r_y, r_sign, pre_ok,
+            axis_name=axis_name,
+        )
+        power = jnp.take(powers, val_idx)
+        partial = tally.tally_kernel(
+            valid, tx_slot, power, prior_stake.shape[0]
+        )
+        total = prior_stake + ring_tally(partial, axis_name)
+        return valid, total, total >= quorum
+
+    v = P(axis_name)
+    f = shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(v, v, v, v, v, v, v, P(), P(), P(), P()),
+        out_specs=(v, v, v),
+    )
+    return jax.jit(f)
