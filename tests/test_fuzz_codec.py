@@ -1,0 +1,143 @@
+"""Property/fuzz tests: codec round-trips and parser crash-resistance.
+
+The wire surface (vote decode, block decode, WAL frames, native prep) is
+attacker-facing — every byte string a peer can send must either decode to
+a value that re-encodes canonically or raise ValueError; nothing may
+crash with any other exception, loop, or mis-round-trip. Hypothesis
+drives both structured round-trips and byte-level mutations.
+"""
+
+import conftest  # noqa: F401
+
+import hashlib
+
+from hypothesis import given, settings, strategies as st
+
+from txflow_tpu import native
+from txflow_tpu.codec import amino
+from txflow_tpu.types.tx_vote import TxVote, decode_tx_vote, encode_tx_vote
+
+ADDR = st.binary(min_size=20, max_size=20)
+SIG = st.binary(min_size=64, max_size=64)
+HASH_HEX = st.text(alphabet="0123456789ABCDEF", min_size=64, max_size=64)
+
+
+@st.composite
+def votes(draw):
+    return TxVote(
+        height=draw(st.integers(min_value=0, max_value=2**62)),
+        tx_hash=draw(HASH_HEX),
+        tx_key=draw(st.binary(min_size=32, max_size=32)),
+        timestamp_ns=draw(st.integers(min_value=0, max_value=2**62)),
+        validator_address=draw(ADDR),
+        signature=draw(SIG),
+    )
+
+
+@given(votes())
+@settings(max_examples=300, deadline=None)
+def test_vote_roundtrip_and_cache(v):
+    wire = encode_tx_vote(v)
+    d = decode_tx_vote(wire)
+    assert (
+        d.height,
+        d.tx_hash,
+        d.tx_key,
+        d.timestamp_ns,
+        d.validator_address,
+        d.signature,
+    ) == (
+        v.height,
+        v.tx_hash,
+        v.tx_key,
+        v.timestamp_ns,
+        v.validator_address,
+        v.signature,
+    )
+    # canonical input must be cached AND re-encode identically
+    assert d._wire_cache == wire
+    assert encode_tx_vote(d) == wire
+
+
+@given(votes(), st.data())
+@settings(max_examples=300, deadline=None)
+def test_vote_decode_never_crashes_on_mutation(v, data):
+    """Arbitrary byte mutations: decode either raises ValueError or
+    returns a vote whose re-encode is canonical (never the mutated bytes
+    unless they equal the canonical encoding)."""
+    wire = bytearray(encode_tx_vote(v))
+    n_mut = data.draw(st.integers(min_value=1, max_value=6))
+    for _ in range(n_mut):
+        i = data.draw(st.integers(min_value=0, max_value=len(wire) - 1))
+        wire[i] = data.draw(st.integers(min_value=0, max_value=255))
+    raw = bytes(wire)
+    try:
+        d = decode_tx_vote(raw)
+    except ValueError:
+        return
+    except UnicodeDecodeError:
+        return  # tx_hash is a str field; invalid utf-8 is a decode error
+    cached = d._wire_cache  # BEFORE encode: encode itself populates it
+    re = encode_tx_vote(d)
+    if cached is not None:
+        # decode only ever caches input bytes proven canonical
+        assert cached == raw == re
+
+
+@given(st.binary(max_size=300))
+@settings(max_examples=300, deadline=None)
+def test_vote_decode_arbitrary_bytes(raw):
+    try:
+        d = decode_tx_vote(raw)
+    except (ValueError, UnicodeDecodeError):
+        return
+    encode_tx_vote(d)  # whatever decoded must re-encode without error
+
+
+@given(st.integers(min_value=0, max_value=2**64 - 1))
+@settings(max_examples=200, deadline=None)
+def test_uvarint_roundtrip(n):
+    buf = amino.uvarint(n)
+    r = amino.AminoReader(buf)
+    assert r.read_uvarint() == n and r.eof()
+
+
+@given(st.integers(min_value=-(2**63), max_value=2**63 - 1))
+@settings(max_examples=200, deadline=None)
+def test_time_body_roundtrip(ns):
+    body = amino.encode_time_body(ns)
+    assert amino.decode_time_body(body) == ns
+
+
+@given(st.binary(max_size=200), st.binary(min_size=64, max_size=64),
+       st.binary(min_size=32, max_size=32))
+@settings(max_examples=150, deadline=None)
+def test_native_prep_matches_python_on_random_inputs(msg, sig, pub):
+    """Random (msg, sig, pub): native and Python prep agree bit-for-bit —
+    including non-curve pubkeys and random S values straddling L."""
+    if not native.available():
+        return
+    import numpy as np
+
+    from txflow_tpu.ops import ed25519_batch
+
+    epoch = ed25519_batch.EpochTables([pub])
+    a = ed25519_batch._prepare_compact_native([msg], [sig], np.array([0]), epoch)
+    b = ed25519_batch._prepare_compact_py([msg], [sig], np.array([0]), epoch)
+    np.testing.assert_array_equal(a.pre_ok, b.pre_ok)
+    np.testing.assert_array_equal(a.s_nibbles, b.s_nibbles)
+    np.testing.assert_array_equal(a.h_nibbles, b.h_nibbles)
+    np.testing.assert_array_equal(a.r_y, b.r_y)
+    np.testing.assert_array_equal(a.r_sign, b.r_sign)
+
+
+@given(st.binary(max_size=400))
+@settings(max_examples=200, deadline=None)
+def test_block_decode_arbitrary_bytes(raw):
+    from txflow_tpu.types.block import decode_block, encode_block
+
+    try:
+        b = decode_block(raw)
+    except (ValueError, UnicodeDecodeError):
+        return
+    encode_block(b)

@@ -191,8 +191,13 @@ def encode_tx_vote(vote: TxVote) -> bytes:
     return out
 
 
-def _uv(data: bytes, pos: int, end: int) -> tuple[int, int]:
-    """Uvarint continuation path (Go binary.Uvarint overflow rules)."""
+def _uv(data: bytes, pos: int, end: int) -> tuple[int, int, bool]:
+    """Uvarint continuation path (Go binary.Uvarint overflow rules).
+
+    Returns (value, new_pos, minimal): ``minimal`` is False for over-long
+    encodings (a trailing 0x00 continuation group). They are ACCEPTED —
+    same accept-set as Go — but the caller must refuse the wire cache,
+    since our encoder would emit the shorter form."""
     n = 0
     shift = 0
     while True:
@@ -204,7 +209,7 @@ def _uv(data: bytes, pos: int, end: int) -> tuple[int, int]:
             raise ValueError("uvarint overflows 64 bits")
         n |= (b & 0x7F) << shift
         if not b & 0x80:
-            return n, pos
+            return n, pos, b != 0
         shift += 7
         if shift > 63:
             raise ValueError("uvarint overflows 64 bits")
@@ -221,15 +226,13 @@ def decode_tx_vote(data: bytes) -> TxVote:
 
     ``canonical`` tracks whether the input is exactly the byte string our
     own encoder emits (fields strictly ordered, no unknown fields, no
-    explicitly-encoded defaults, normalized time body): only then are the
-    input bytes cached as the vote's wire form, so re-gossip and TxStore
-    certificate encoding never re-serialize. Non-canonical peer encodings
-    fall back to a real re-serialize like the reference (Go amino
-    re-marshals from the struct). Over-long varints are the one
-    undetected variance — the cached bytes would still be a valid
-    encoding of the same vote; dedup keys off sha256(signature) and sign
-    bytes are rebuilt from fields, so nothing depends on byte
-    canonicality.
+    explicitly-encoded defaults, minimal varints, normalized time body):
+    only then are the input bytes cached as the vote's wire form, so
+    re-gossip and TxStore certificate encoding never re-serialize.
+    Non-canonical peer encodings fall back to a real re-serialize like
+    the reference (Go amino re-marshals from the struct). The cache
+    contract is exact — cached bytes are bit-identical to
+    encode_tx_vote's output — and fuzz-pinned (tests/test_fuzz_codec.py).
     """
     pos = 0
     end = len(data)
@@ -248,7 +251,9 @@ def decode_tx_vote(data: bytes) -> TxVote:
                 key = b
                 pos += 1
             else:
-                key, pos = _uv(data, pos, end)
+                key, pos, mini = _uv(data, pos, end)
+                if not mini:
+                    canonical = False
             fnum = key >> 3
             typ3 = key & 7
             if fnum <= prev_fnum:
@@ -260,7 +265,9 @@ def decode_tx_vote(data: bytes) -> TxVote:
                     ln = b
                     pos += 1
                 else:
-                    ln, pos = _uv(data, pos, end)
+                    ln, pos, mini = _uv(data, pos, end)
+                    if not mini:
+                        canonical = False
                 npos = pos + ln
                 if npos > end:
                     raise ValueError("truncated byte field")
@@ -297,7 +304,9 @@ def decode_tx_vote(data: bytes) -> TxVote:
                     v = b
                     pos += 1
                 else:
-                    v, pos = _uv(data, pos, end)
+                    v, pos, mini = _uv(data, pos, end)
+                    if not mini:
+                        canonical = False
                 if fnum == 1:
                     height = v - (1 << 64) if v >= 1 << 63 else v
                     if height == 0:
@@ -348,7 +357,9 @@ def _decode_ts_body(body: bytes) -> tuple[int, bool]:
             key = b
             pos += 1
         else:
-            key, pos = _uv(body, pos, end)
+            key, pos, mini = _uv(body, pos, end)
+            if not mini:
+                canonical = False
         fnum = key >> 3
         typ3 = key & 7
         if fnum <= prev:
@@ -360,7 +371,9 @@ def _decode_ts_body(body: bytes) -> tuple[int, bool]:
                 v = b
                 pos += 1
             else:
-                v, pos = _uv(body, pos, end)
+                v, pos, mini = _uv(body, pos, end)
+                if not mini:
+                    canonical = False
             if fnum == 1:
                 seconds = v - (1 << 64) if v >= 1 << 63 else v
                 if seconds == 0:
@@ -382,7 +395,9 @@ def _decode_ts_body(body: bytes) -> tuple[int, bool]:
                 ln = b
                 pos += 1
             else:
-                ln, pos = _uv(body, pos, end)
+                ln, pos, mini = _uv(body, pos, end)
+                if not mini:
+                    canonical = False
             if pos + ln > end:
                 raise ValueError("truncated byte field")
             pos += ln
