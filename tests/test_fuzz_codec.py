@@ -141,3 +141,43 @@ def test_block_decode_arbitrary_bytes(raw):
     except (ValueError, UnicodeDecodeError):
         return
     encode_block(b)
+
+
+@given(st.binary(max_size=300))
+@settings(max_examples=200, deadline=None)
+def test_block_vote_decode_arbitrary_bytes(raw):
+    from txflow_tpu.types.block_vote import decode_block_vote, encode_block_vote
+
+    try:
+        v = decode_block_vote(raw)
+    except (ValueError, UnicodeDecodeError):
+        return
+    encode_block_vote(v)
+
+
+@given(st.binary(max_size=300))
+@settings(max_examples=200, deadline=None)
+def test_evidence_decode_arbitrary_bytes(raw):
+    from txflow_tpu.types.evidence import decode_evidence, encode_evidence
+
+    try:
+        ev = decode_evidence(raw)
+    except (ValueError, UnicodeDecodeError):
+        return
+    encode_evidence(ev)
+
+
+@given(st.binary(max_size=300))
+@settings(max_examples=200, deadline=None)
+def test_consensus_wal_frame_arbitrary_bytes(raw):
+    """WAL frames come from our own disk, but the decode path is shared
+    with catchup replay of possibly-torn logs: ValueError or a decodable
+    message, never another exception."""
+    import json as _json
+
+    from txflow_tpu.consensus.wal import decode_wal_message
+
+    try:
+        decode_wal_message(raw)
+    except (ValueError, KeyError, _json.JSONDecodeError, UnicodeDecodeError):
+        return

@@ -74,11 +74,17 @@ def encode_evidence(ev) -> bytes:
 
 
 def decode_evidence(data: bytes):
+    if not data:
+        raise ValueError("empty evidence")  # peer-facing: never IndexError
     kind, rest = data[0], data[1:]
     ln, off = amino.read_uvarint(rest, 0)
+    if off + ln > len(rest):
+        raise ValueError("truncated evidence vote a")
     a_raw = rest[off : off + ln]
     off += ln
     ln2, off = amino.read_uvarint(rest, off)
+    if off + ln2 > len(rest):
+        raise ValueError("truncated evidence vote b")
     b_raw = rest[off : off + ln2]
     if kind == EV_BLOCK_VOTE:
         return DuplicateBlockVoteEvidence(
