@@ -173,11 +173,9 @@ def test_consensus_wal_frame_arbitrary_bytes(raw):
     """WAL frames come from our own disk, but the decode path is shared
     with catchup replay of possibly-torn logs: ValueError or a decodable
     message, never another exception."""
-    import json as _json
-
     from txflow_tpu.consensus.wal import decode_wal_message
 
     try:
         decode_wal_message(raw)
-    except (ValueError, KeyError, _json.JSONDecodeError, UnicodeDecodeError):
+    except (ValueError, UnicodeDecodeError):
         return

@@ -58,8 +58,23 @@ def encode_wal_end_height(height: int) -> bytes:
 
 def decode_wal_message(raw: bytes):
     """Returns (kind, payload): ('proposal', (Proposal, Block|None)) |
-    ('vote', BlockVote) | ('timeout', TimeoutInfo) | ('end_height', int)."""
-    d = json.loads(raw)
+    ('vote', BlockVote) | ('timeout', TimeoutInfo) | ('end_height', int).
+
+    Raises ValueError on any malformed frame (the CRC layer makes those
+    near-impossible from our own disk, but replay must be total)."""
+    try:
+        d = json.loads(raw)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"bad WAL frame: {e}") from None
+    if not isinstance(d, dict) or "t" not in d:
+        raise ValueError("malformed WAL frame")
+    try:
+        return _decode_wal_fields(d)
+    except (KeyError, TypeError) as e:
+        raise ValueError(f"malformed WAL frame: {e!r}") from None
+
+
+def _decode_wal_fields(d: dict):
     kind = d["t"]
     if kind == "proposal":
         p = Proposal(
