@@ -170,6 +170,26 @@ def run_bench(platform: str) -> dict:
             )
         print(f"bench: kernel warm in {time.time()-t0:.1f}s", file=sys.stderr)
 
+        # supplementary metric: steady-state device-step throughput at the
+        # bucket size (prep + kernel + packed readback, no pools/gossip/
+        # commit) — the capability ceiling the end-to-end number runs under
+        import numpy as _np
+
+        _n = bucket
+        _msgs = [b"kbench-%d" % i for i in range(_n)]
+        _sigs = [b"\x00" * 64] * _n
+        _vidx = _np.zeros(_n, _np.int64)
+        _slot = _np.arange(_n, dtype=_np.int64) % max(_n // n_vals, 1)
+        shared_verifier.verify_and_tally(_msgs, _sigs, _vidx, _slot, _n)
+        _t0 = time.time()
+        for _ in range(3):
+            shared_verifier.verify_and_tally(_msgs, _sigs, _vidx, _slot, _n)
+        device_step_votes_per_sec = round(3 * _n / (time.time() - _t0), 1)
+        print(
+            f"bench: device step {device_step_votes_per_sec:.0f} votes/s",
+            file=sys.stderr,
+        )
+
         # measured on-TPU: merged cross-engine batches LOST ~17% end to end
         # (10.6k vs 12.7k votes/s) — per-vote kernel cost is nearly flat in
         # batch size (27.6 us at 4096 vs 25.6 at 16384), so the mux's
@@ -376,6 +396,8 @@ def run_bench(platform: str) -> dict:
         "wall_s": round(wall, 3),
         "app_commit_interval": cfg.engine.commit_interval,
     }
+    if verifier_kind == "device":
+        result["device_step_votes_per_sec"] = device_step_votes_per_sec
     if byz_frac > 0:
         result["byzantine_fraction"] = byz_frac
         byz_addr = net.priv_vals[0].get_address()
