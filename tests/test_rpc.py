@@ -276,3 +276,18 @@ def test_websocket_event_stream():
         s.close()
     finally:
         net.stop()
+
+
+def test_broadcast_tx_commit():
+    """One-call submit-and-wait (tendermint broadcast_tx_commit)."""
+    from txflow_tpu.node import LocalNet
+
+    net = LocalNet(4, use_device_verifier=False, rpc=True)
+    net.start()
+    try:
+        addr = net.nodes[0].rpc.addr
+        res = rpc_get(addr, '/broadcast_tx_commit?tx="btc-k=v"')["result"]
+        assert res["committed"] is True
+        assert res["hash"] == hashlib.sha256(b"btc-k=v").hexdigest().upper()
+    finally:
+        net.stop()

@@ -136,6 +136,7 @@ class RPCServer:
         self._routes = {
             "/broadcast_tx": self._broadcast_tx,
             "/broadcast_tx_sync": self._broadcast_tx,
+            "/broadcast_tx_commit": self._broadcast_tx_commit,
             "/status": self._status,
             "/tx": self._tx,
             "/subscribe_tx": self._subscribe_tx,
@@ -171,6 +172,15 @@ class RPCServer:
         tx = _parse_tx_param(q["tx"])
         self.node.broadcast_tx(tx)
         return {"hash": hashlib.sha256(tx).hexdigest().upper(), "code": 0}
+
+    def _broadcast_tx_commit(self, q: dict) -> dict:
+        """Submit + wait for the commit in one call (tendermint's
+        broadcast_tx_commit; resolves via EITHER commit path)."""
+        res = self._broadcast_tx(q)
+        sub = self._subscribe_tx(
+            {"hash": res["hash"], "timeout": q.get("timeout", "30")}
+        )
+        return {**res, **sub}
 
     def _status(self, q: dict) -> dict:
         node = self.node
