@@ -273,3 +273,50 @@ def test_ring_tally_matches_psum_step():
     for sh in range(8):
         _np.testing.assert_array_equal(stake[sh], _np.asarray(a[1]))
         _np.testing.assert_array_equal(maj[sh], _np.asarray(a[2]))
+
+
+def test_verifier_mux_error_propagates_to_all_waiters():
+    """An inner-verifier failure must surface to every merged caller and
+    leave the mux serviceable for the next call."""
+    import threading
+
+    from txflow_tpu.verifier import VerifierMux
+
+    vals, seeds = make_valset(4)
+
+    class Flaky:
+        def __init__(self, inner):
+            self.inner = inner
+            self.val_set = inner.val_set
+            self.fail = True
+
+        def verify_and_tally(self, *a, **k):
+            if self.fail:
+                raise RuntimeError("device fell over")
+            return self.inner.verify_and_tally(*a, **k)
+
+    flaky = Flaky(ScalarVoteVerifier(vals))
+    mux = VerifierMux(flaky, gather_wait=0.05)
+    mux.start()
+    try:
+        msgs, sigs, vidx, slot = make_batch(vals, seeds, n_txs=2)
+        errs, oks = [], []
+
+        def call():
+            try:
+                oks.append(mux.verify_and_tally(msgs, sigs, vidx, slot, 2))
+            except RuntimeError as e:
+                errs.append(e)
+
+        ts = [threading.Thread(target=call) for _ in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert len(errs) == 3 and not oks
+
+        flaky.fail = False  # mux must still serve after the failure
+        r = mux.verify_and_tally(msgs, sigs, vidx, slot, 2)
+        assert r.valid.all()
+    finally:
+        mux.stop()
