@@ -414,6 +414,12 @@ def run_bench(platform: str) -> dict:
                     if votes and byz_addr in {v.validator_address for v in votes}:
                         bad += 1
         result["byzantine_votes_in_certificates"] = bad
+        if bad:
+            # a corrupted signature landing in a commit certificate is a
+            # soundness regression, not a perf data point — fail loudly
+            raise AssertionError(
+                f"{bad} byzantine votes appeared in commit certificates"
+            )
     if with_consensus:
         result["consensus"] = True
         result["block_height"] = max(n.block_store.height() for n in net.nodes)

@@ -1,0 +1,153 @@
+"""Host commit-pipeline profiler (the non-kernel side of the bench).
+
+Runs the bench's exact LocalNet replay protocol with an INSTANT verifier —
+every vote accepted with zero crypto cost — so the measured votes/s is the
+ceiling imposed by the host pipeline alone: pool drain, batch routing,
+TxStore persist, ABCI deliver/commit, event fan-out, pool purge, gossip.
+The end-to-end TPU number can never exceed this; r3 measured it at ~17k/s
+while the kernel alone did 36-39k/s, making this THE optimization target
+(VERDICT r3 item 1).
+
+Usage:  JAX_PLATFORMS=cpu python profile_host.py [--profile] [--txs N]
+--profile additionally cProfiles every engine/committer thread and prints
+the merged top-40 by cumulative time.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import hashlib
+import os
+import pstats
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+from txflow_tpu.node import LocalNet
+from txflow_tpu.types import TxVote
+from txflow_tpu.utils.config import test_config
+from txflow_tpu.verifier import ScalarVoteVerifier, TallyResult, first_occurrence_mask
+
+
+class InstantVoteVerifier(ScalarVoteVerifier):
+    """Accepts every vote from a known validator without verifying.
+
+    Profiling-only: isolates the host pipeline from crypto cost."""
+
+    def verify_and_tally(
+        self, msgs, sigs, val_idx, tx_slot, n_slots,
+        prior_stake=None, quorum=None,
+    ) -> TallyResult:
+        n = len(msgs)
+        val_idx = np.asarray(val_idx)
+        tx_slot = np.asarray(tx_slot)
+        keep = first_occurrence_mask(tx_slot, val_idx)
+        valid = keep & (val_idx >= 0) & (val_idx < len(self._pub_keys))
+        stake = (
+            np.zeros(n_slots, dtype=np.int64)
+            if prior_stake is None
+            else np.asarray(prior_stake, dtype=np.int64).copy()
+        )
+        np.add.at(stake, tx_slot[valid], self._powers[val_idx[valid]])
+        q = self.val_set.quorum_power() if quorum is None else quorum
+        return TallyResult(valid, stake, stake >= q, ~keep)
+
+
+def main() -> None:
+    do_profile = "--profile" in sys.argv
+    n_txs = 8192
+    if "--txs" in sys.argv:
+        n_txs = int(sys.argv[sys.argv.index("--txs") + 1])
+    n_vals = int(os.environ.get("BENCH_VALIDATORS", "4"))
+    chunk = 2048
+
+    cfg = test_config()
+    cfg.mempool.size = max(cfg.mempool.size, 8 * n_txs * (n_vals + 1))
+    cfg.mempool.cache_size = 2 * cfg.mempool.size
+    cfg.engine.min_batch = int(os.environ.get("BENCH_MIN_BATCH", "3072"))
+    cfg.engine.batch_wait = float(os.environ.get("BENCH_BATCH_WAIT", "0.05"))
+    cfg.engine.commit_interval = int(os.environ.get("BENCH_COMMIT_INTERVAL", "1"))
+
+    net = LocalNet(
+        n_vals,
+        chain_id="txflow-bench",
+        config=cfg,
+        use_device_verifier=False,
+        sign=False,
+        mempool_broadcast=False,
+        index_txs=False,
+    )
+    for node in net.nodes:
+        node.txflow.verifier = InstantVoteVerifier(net.val_set)
+
+    profilers: list[cProfile.Profile] = []
+    if do_profile:
+        # wrap each engine's two hot threads before start()
+        for node in net.nodes:
+            for attr in ("_run", "_committer_run"):
+                orig = getattr(node.txflow, attr)
+                prof = cProfile.Profile()
+                profilers.append(prof)
+
+                def wrapped(orig=orig, prof=prof):
+                    prof.enable()
+                    try:
+                        orig()
+                    finally:
+                        prof.disable()
+
+                setattr(node.txflow, attr, wrapped)
+
+    txs = [b"tx-%d=v" % i for i in range(n_txs)]
+    votes_by_val: list[list[TxVote]] = [[] for _ in range(n_vals)]
+    for tx in txs:
+        tx_key = hashlib.sha256(tx).digest()
+        tx_hash = tx_key.hex().upper()
+        for vi, pv in enumerate(net.priv_vals):
+            vote = TxVote(
+                height=0, tx_hash=tx_hash, tx_key=tx_key,
+                validator_address=pv.get_address(),
+            )
+            pv.sign_tx_vote("txflow-bench", vote)
+            votes_by_val[vi].append(vote)
+
+    net.start()
+    t0 = time.perf_counter()
+    for base in range(0, n_txs, chunk):
+        for node in net.nodes:
+            for tx in txs[base : base + chunk]:
+                try:
+                    node.mempool.check_tx(tx)
+                except Exception:
+                    pass
+        for vi, node in enumerate(net.nodes):
+            pool = node.tx_vote_pool
+            for vote in votes_by_val[vi][base : base + chunk]:
+                try:
+                    pool.check_tx(vote)
+                except Exception:
+                    pass
+    ok = net.wait_all_committed(txs, timeout=600.0)
+    wall = time.perf_counter() - t0
+    committed = net.committed_votes_total()
+    net.stop()
+    if not ok:
+        print("TIMEOUT", file=sys.stderr)
+    print(
+        f"host-pipeline ceiling: {committed/wall:,.0f} committed votes/s "
+        f"({committed} votes, {wall:.2f}s, {n_vals} validators, {n_txs} txs)"
+    )
+
+    if do_profile:
+        merged = pstats.Stats(profilers[0])
+        for p in profilers[1:]:
+            merged.add(p)
+        merged.sort_stats("cumulative")
+        merged.print_stats(40)
+
+
+if __name__ == "__main__":
+    main()

@@ -320,3 +320,40 @@ def test_verifier_mux_error_propagates_to_all_waiters():
         assert r.valid.all()
     finally:
         mux.stop()
+
+
+def test_verifier_mux_stop_strands_no_callers():
+    """stop() must release every in-flight caller: queued requests (even
+    ones enqueued concurrently with shutdown) either get served inline on
+    the inner verifier or fail with RuntimeError — no thread may block in
+    done.wait() forever (r3 advisor low)."""
+    import threading
+    import time
+
+    from txflow_tpu.verifier import VerifierMux
+
+    vals, seeds = make_valset(4)
+    mux = VerifierMux(ScalarVoteVerifier(vals), gather_wait=0.05)
+    mux.start()
+    results = []
+
+    def caller():
+        msgs, sigs, vidx, slot = make_batch(vals, seeds, n_txs=1)
+        try:
+            r = mux.verify_and_tally(msgs, sigs, vidx, slot, 1)
+            results.append(("ok", bool(r.valid.all())))
+        except RuntimeError as e:
+            results.append(("stopped", str(e)))
+
+    threads = [threading.Thread(target=caller) for _ in range(8)]
+    for t in threads:
+        t.start()
+    time.sleep(0.01)
+    mux.stop()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive(), "caller stranded in done.wait() after stop()"
+    assert len(results) == 8
+    # served results must be correct; failures must be the shutdown error
+    for kind, val in results:
+        assert (kind == "ok" and val is True) or kind == "stopped", results

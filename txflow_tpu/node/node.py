@@ -209,16 +209,22 @@ class Node:
         from ..pool.evidence import EvidencePool
         from ..reactors.evidence_reactor import EvidenceReactor
 
+        # committed-evidence markers share the block store's db (prefix
+        # EV:): any node that persists blocks also persists the markers,
+        # so the already-committed check survives restarts and fast-sync
+        # (r3 advisor: an in-memory set diverges between honest nodes)
+        self._block_db = block_db if block_db is not None else MemDB()
         self.evidence_pool = EvidencePool(
             chain_id,
             lambda: self.state_view().validators,
             event_bus=self.event_bus,
+            db=self._block_db,
         )
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
         self.switch.add_reactor("evidence", self.evidence_reactor)
 
         # -- block path: stores + executor + consensus (node/node.go:636-680) --
-        self.block_store = BlockStore(block_db if block_db is not None else MemDB())
+        self.block_store = BlockStore(self._block_db)
         self.block_executor = BlockExecutor(
             self.state_store,
             self.proxy_app.consensus,

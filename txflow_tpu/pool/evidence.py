@@ -22,13 +22,20 @@ class EvidencePool:
         chain_id: str,
         val_set_provider,  # () -> ValidatorSet for verification
         event_bus: EventBus | None = None,
+        db=None,  # durable committed-marker store (shared with BlockStore)
     ):
         self.chain_id = chain_id
         self._val_set_provider = val_set_provider
         self.event_bus = event_bus
         self._mtx = threading.Lock()
         self._pending: dict[bytes, object] = {}  # hash -> evidence
+        # committed markers: in-memory set backed by durable `EV:<hash>`
+        # rows when a db is given. The reference checks a persisted store
+        # (state/validation.go:148); a memory-only set diverges after
+        # fast-sync/restart — an archival node rejects a re-included proof
+        # that a freshly-synced node would accept (r3 advisor low).
         self._committed: set[bytes] = set()
+        self._db = db
         self.on_add = lambda ev: None  # reactor hook: gossip new evidence
 
     def add(self, ev) -> tuple[bool, str | None]:
@@ -37,6 +44,8 @@ class EvidencePool:
         with self._mtx:
             if h in self._pending or h in self._committed:
                 return False, None  # known: not an error
+        if self._db is not None and self._db.has(b"EV:" + h):
+            return False, None  # committed before a restart
         val_set: ValidatorSet = self._val_set_provider()
         _, val = val_set.get_by_address(ev.validator_address)
         if val is None:
@@ -67,11 +76,16 @@ class EvidencePool:
     def has(self, ev) -> bool:
         h = ev.hash()
         with self._mtx:
-            return h in self._pending or h in self._committed
+            if h in self._pending or h in self._committed:
+                return True
+        return self._db is not None and self._db.has(b"EV:" + h)
 
     def is_committed(self, ev) -> bool:
+        h = ev.hash()
         with self._mtx:
-            return ev.hash() in self._committed
+            if h in self._committed:
+                return True
+        return self._db is not None and self._db.has(b"EV:" + h)
 
     def drop(self, ev) -> None:
         """Remove evidence that turned out unusable (e.g. its validator
@@ -87,6 +101,8 @@ class EvidencePool:
                 h = ev.hash()
                 self._pending.pop(h, None)
                 self._committed.add(h)
+                if self._db is not None:
+                    self._db.set(b"EV:" + h, b"1")
 
     def prune(self, current_height: int) -> int:
         """Drop pending evidence older than MAX_AGE_HEIGHTS."""

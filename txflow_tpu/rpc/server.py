@@ -253,7 +253,37 @@ class RPCServer:
             ).encode()
         )
         handler.wfile.flush()
-        rf, wf = handler.rfile, handler.wfile
+        conn, wf = handler.connection, handler.wfile
+
+        # Bounded IO from here on: a stalled client (suspended process,
+        # half-dead link) must not wedge send_frame forever — the pump
+        # would hold wlock, the reader's pong path would block behind it,
+        # and unsubscribe would never run. Sends now fail after the
+        # timeout; reads below retry on it (idle is normal for a reader).
+        conn.settimeout(30.0)
+
+        # Drain whatever the handshake's buffered reader already pulled
+        # off the socket (a pipelining client's first frames can sit in
+        # handler.rfile): everything after this comes from conn.recv,
+        # which — unlike BufferedReader.read under a timeout — never
+        # discards partially-read data.
+        rf = handler.rfile
+        buffered = b""
+        try:
+            conn.settimeout(0.001)
+            while True:
+                peeked = rf.peek(1)
+                if not peeked:
+                    break
+                buffered += rf.read(len(peeked))
+        except (TimeoutError, OSError):
+            pass  # rfile buffer empty: the raw peek hit the socket
+        finally:
+            conn.settimeout(30.0)
+
+        # one writer lock: the event pump and the reader thread's pongs
+        # both send frames
+        wlock = threading.Lock()
 
         def send_frame(opcode: int, payload: bytes) -> None:
             hdr = bytes([0x80 | opcode])
@@ -264,28 +294,59 @@ class RPCServer:
                 hdr += bytes([126]) + _st.pack(">H", n)
             else:
                 hdr += bytes([127]) + _st.pack(">Q", n)
-            wf.write(hdr + payload)
-            wf.flush()
+            with wlock:
+                wf.write(hdr + payload)
+                wf.flush()
 
-        def recv_frame():
-            b0 = rf.read(1)
-            if not b0:
-                return None, b""
-            opcode = b0[0] & 0x0F
-            b1 = rf.read(1)[0]
+        def read_exact(n: int, deadline: float | None = None) -> bytes:
+            """EOF mid-frame is a close, never a partial read or a
+            mid-frame resume: a short read would desync RFC6455 framing
+            for the rest of the connection (r3 advisor medium). Timeouts
+            between frames are idle, not errors — retry (listen-only
+            clients are legitimate), unless a deadline is given."""
+            nonlocal buffered
+            out = b""
+            while len(out) < n:
+                if buffered:
+                    take = min(n - len(out), len(buffered))
+                    out += buffered[:take]
+                    buffered = buffered[take:]
+                    continue
+                try:
+                    chunk = conn.recv(n - len(out))
+                except TimeoutError:
+                    if deadline is not None and time.monotonic() > deadline:
+                        raise ConnectionError("websocket read deadline")
+                    continue  # idle poll; partial bytes stay in `out`
+                if not chunk:
+                    raise ConnectionError("websocket closed")
+                out += chunk
+            return out
+
+        def recv_frame(deadline: float | None = None):
+            b0 = read_exact(1, deadline)[0]
+            opcode = b0 & 0x0F
+            b1 = read_exact(1, deadline)[0]
             n = b1 & 0x7F
             if n == 126:
-                (n,) = _st.unpack(">H", rf.read(2))
+                (n,) = _st.unpack(">H", read_exact(2, deadline))
             elif n == 127:
-                (n,) = _st.unpack(">Q", rf.read(8))
-            mask = rf.read(4) if b1 & 0x80 else b""  # clients MUST mask
-            data = rf.read(n)
+                (n,) = _st.unpack(">Q", read_exact(8, deadline))
+            # inbound frames are a small JSON subscribe + <=125-byte
+            # control frames: a client-declared 64-bit length must not
+            # make read_exact buffer unbounded memory
+            if n > 1 << 20:
+                raise ConnectionError(f"websocket frame too large ({n} bytes)")
+            mask = read_exact(4, deadline) if b1 & 0x80 else b""  # clients MUST mask
+            data = read_exact(n, deadline) if n else b""
             if mask:
                 data = bytes(c ^ mask[i % 4] for i, c in enumerate(data))
             return opcode, data
 
         try:
-            opcode, data = recv_frame()
+            # the subscribe frame must arrive promptly; after that the
+            # client may stay silent forever (listen-only)
+            opcode, data = recv_frame(deadline=time.monotonic() + 30.0)
             if opcode != 1:  # expect a text subscribe frame
                 send_frame(8, b"")
                 return
@@ -295,25 +356,42 @@ class RPCServer:
                 send_frame(1, json.dumps({"error": "unknown event"}).encode())
                 send_frame(8, b"")
                 return
+            # the subscription must be released on EVERY exit (an ack
+            # write to a just-reset connection raises before the pump
+            # starts): everything past subscribe() runs under the finally
             sub = self.node.event_bus.subscribe(event_type)
-            send_frame(1, json.dumps({"subscribed": event_type}).encode())
             try:
-                handler.connection.settimeout(0.5)
-                while True:
+                send_frame(1, json.dumps({"subscribed": event_type}).encode())
+
+                # reader thread: blocking control-frame loop (ping/close).
+                # Event delivery must not gate on client chatter — the old
+                # interleaved 0.5 s recv poll capped delivery at ~2
+                # events/s and a timeout landing mid-frame desynced the
+                # framing.
+                closed = threading.Event()
+
+                def reader() -> None:
+                    try:
+                        while True:
+                            op, payload = recv_frame()
+                            if op == 8:  # close
+                                return
+                            if op == 9:  # ping -> pong
+                                send_frame(10, payload)
+                    except (ConnectionError, OSError, _st.error):
+                        pass
+                    finally:
+                        closed.set()
+
+                rt = threading.Thread(target=reader, name="ws-reader", daemon=True)
+                rt.start()
+                while not closed.is_set():
                     ev = sub.get(timeout=0.5)
                     if ev is not None:
                         send_frame(1, json.dumps(_event_json(ev)).encode())
-                    # poll for a client close/ping between events
-                    try:
-                        opcode, data = recv_frame()
-                    except (TimeoutError, OSError):
-                        continue
-                    if opcode is None or opcode == 8:  # closed
-                        return
-                    if opcode == 9:  # ping -> pong
-                        send_frame(10, data)
             finally:
                 self.node.event_bus.unsubscribe(event_type, sub)
+                # handler return closes the socket, unblocking the reader
         except (BrokenPipeError, ConnectionError, OSError):
             pass
 

@@ -23,6 +23,7 @@ import time
 
 from ..abci.proxy import AppConnConsensus
 from ..abci.types import RequestBeginBlock, RequestEndBlock, ResponseDeliverTx
+from ..pool.evidence import MAX_AGE_HEIGHTS
 from ..pool.mempool import Mempool
 from ..types.block import Block
 from ..types.block_vote import BlockCommit, BlockVoteSet, PRECOMMIT
@@ -41,6 +42,14 @@ from .state import ABCIResponses, State
 from .store import StateStore
 
 MAX_BLOCK_BYTES = 1024 * 1024  # one-part block cap (framework-native)
+
+# Per-block evidence budget (reference state/validation.go:135-148
+# enforces MaxEvidencePerBlock; without it a byzantine validator can sign
+# unlimited distinct equivocation pairs — each individually valid — and a
+# proposer reaping ALL pending would build a block every node must fully
+# re-verify). Proposals reap at most this many; validation rejects blocks
+# over it.
+MAX_EVIDENCE_PER_BLOCK = 64
 
 
 def verify_commit(
@@ -118,9 +127,12 @@ class BlockExecutor:
         evidence = []
         if self.evidence_pool is not None:
             for ev in self.evidence_pool.pending():
+                if len(evidence) >= MAX_EVIDENCE_PER_BLOCK:
+                    break  # rest waits for the next proposal
                 _, val = state.validators.get_by_address(ev.validator_address)
                 if (
                     0 < ev.height() <= height
+                    and ev.height() > height - MAX_AGE_HEIGHTS
                     and val is not None
                     and ev.verify(state.chain_id, val.pub_key) is None
                 ):
@@ -166,6 +178,11 @@ class BlockExecutor:
         from ..types.block import evidence_root
 
         if block.evidence:
+            if len(block.evidence) > MAX_EVIDENCE_PER_BLOCK:
+                return (
+                    f"too much evidence: {len(block.evidence)} > "
+                    f"{MAX_EVIDENCE_PER_BLOCK}"
+                )
             if h.evidence_hash != evidence_root(block.evidence):
                 return "wrong EvidenceHash"
             seen_ev = set()
@@ -178,11 +195,13 @@ class BlockExecutor:
                     # one offense, one punishment: a byzantine proposer
                     # re-including already-committed evidence must not make
                     # the app see the validator as byzantine twice (the
-                    # committed set is in-memory; after a restart the
-                    # handshake replays committed blocks, which re-marks it)
+                    # committed markers are durable `EV:` rows in the block
+                    # db, so the check also holds across restarts)
                     return "evidence already committed"
                 if not (0 < ev.height() <= h.height):
                     return "evidence from an impossible height"
+                if ev.height() <= h.height - MAX_AGE_HEIGHTS:
+                    return "evidence is too old"
                 _, val = state.validators.get_by_address(ev.validator_address)
                 if val is None:
                     return "evidence names an unknown validator"

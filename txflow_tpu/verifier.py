@@ -295,6 +295,11 @@ class VerifierMux:
         self._running = False
         self._thread: _t.Thread | None = None
         self._lock = _t.Lock()
+        # dispatcher generation: a dispatcher that outlives its stop() (a
+        # long device batch ran past the join timeout) exits on its own at
+        # the next loop turn instead of racing a restarted dispatcher for
+        # the queue
+        self._gen = 0
 
     def start(self) -> None:
         import threading as _t
@@ -303,16 +308,47 @@ class VerifierMux:
             if self._running:
                 return
             self._running = True
-        self._thread = _t.Thread(target=self._run, name="verifier-mux", daemon=True)
+            self._gen += 1
+            gen = self._gen
+        self._thread = _t.Thread(
+            target=self._run, args=(gen,), name="verifier-mux", daemon=True
+        )
         self._thread.start()
 
     def stop(self) -> None:
         with self._lock:
             self._running = False
         self._q.put(None)
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        thread = self._thread
+        self._thread = None
+        if thread is not None:
+            thread.join(timeout=5)
+            if thread.is_alive():
+                # dispatcher is mid-batch: the queue is still its to drain
+                # (it fails leftovers itself on exit — see _run); draining
+                # here would steal the sentinel it needs
+                return
+        # requests still queued (behind the sentinel, or enqueued by a
+        # caller that raced the _running check) would otherwise strand
+        # their threads in done.wait() forever (r3 advisor low): fail them
+        self._fail_queued(RuntimeError("VerifierMux stopped"))
+
+    def _fail_queued(self, err: Exception) -> None:
+        import queue as _q
+
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except _q.Empty:
+                return
+            if req is None:
+                continue
+            with self._lock:
+                if req.claimed:
+                    continue
+                req.claimed = True
+            req.error = err
+            req.done.set()
 
     def warmup(self, n: int = 1) -> None:
         self.inner.warmup(n)
@@ -338,20 +374,47 @@ class VerifierMux:
             _t.Event(),
         )
         self._q.put(req)
-        req.done.wait()
+        # bounded wait + liveness re-check: if the mux stopped after the
+        # _running check above, the dispatcher may never see this request —
+        # claim it back and serve it inline on the inner verifier
+        while not req.done.wait(timeout=1.0):
+            if not self._running:
+                with self._lock:
+                    orphaned = not req.claimed
+                    if orphaned:
+                        req.claimed = True
+                if orphaned:
+                    return self.inner.verify_and_tally(
+                        req.msgs, req.sigs, req.val_idx, req.tx_slot,
+                        req.n_slots, prior_stake=req.prior,
+                    )
+                req.done.wait()  # claimed by the dispatcher: finish soon
+                break
         if req.error is not None:
             raise req.error
         return req.result
 
-    def _run(self) -> None:
+    def _run(self, gen: int) -> None:
         import queue as _q
         import time as _time
 
+        def retired() -> bool:
+            # stopped, or superseded by a restart while we ran a long batch
+            return not self._running or self._gen != gen
+
         inner_cap = getattr(self.inner, "max_batch", 1 << 30)
         while True:
+            if retired():
+                # we own the queue until we exit: fail anything left so no
+                # caller strands (stop() skips its own drain while we live)
+                if self._gen == gen:
+                    self._fail_queued(RuntimeError("VerifierMux stopped"))
+                return
             req = self._q.get()
             if req is None:
-                if not self._running:
+                if retired():
+                    if self._gen == gen:
+                        self._fail_queued(RuntimeError("VerifierMux stopped"))
                     return
                 continue
             batch = [req]
@@ -366,6 +429,8 @@ class VerifierMux:
                 if nxt is None:
                     if not self._running:
                         self._serve(batch)
+                        if self._gen == gen:
+                            self._fail_queued(RuntimeError("VerifierMux stopped"))
                         return
                     continue
                 if total + len(nxt.msgs) > inner_cap:
@@ -376,6 +441,14 @@ class VerifierMux:
             self._serve(batch)
 
     def _serve(self, batch: list) -> None:
+        # claim every request first: one already claimed was failed by
+        # stop() or reclaimed by its caller — it is no longer ours to serve
+        with self._lock:
+            batch = [r for r in batch if not r.claimed]
+            for r in batch:
+                r.claimed = True
+        if not batch:
+            return
         try:
             if len(batch) == 1:
                 r = batch[0]
@@ -424,7 +497,7 @@ class VerifierMux:
 class _MuxReq:
     __slots__ = (
         "msgs", "sigs", "val_idx", "tx_slot", "n_slots", "prior",
-        "done", "result", "error",
+        "done", "result", "error", "claimed",
     )
 
     def __init__(self, msgs, sigs, val_idx, tx_slot, n_slots, prior, done):
@@ -437,3 +510,7 @@ class _MuxReq:
         self.done = done
         self.result = None
         self.error = None
+        # exactly-once service marker (set under the mux lock): the
+        # dispatcher claims requests it serves; a caller that raced stop()
+        # claims its own request back and serves it inline — never both
+        self.claimed = False
