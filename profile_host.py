@@ -83,23 +83,25 @@ def main() -> None:
     for node in net.nodes:
         node.txflow.verifier = InstantVoteVerifier(net.val_set)
 
-    profilers: list[cProfile.Profile] = []
+    prof: cProfile.Profile | None = None
     if do_profile:
-        # wrap each engine's two hot threads before start()
-        for node in net.nodes:
-            for attr in ("_run", "_committer_run"):
-                orig = getattr(node.txflow, attr)
-                prof = cProfile.Profile()
-                profilers.append(prof)
+        # CPython 3.12 allows ONE active profiler per process: profile a
+        # single thread of node 0 per run (--thread run|commit)
+        attr = "_committer_run" if "--thread" in sys.argv and sys.argv[
+            sys.argv.index("--thread") + 1
+        ] == "commit" else "_run"
+        node = net.nodes[0]
+        orig = getattr(node.txflow, attr)
+        prof = cProfile.Profile()
 
-                def wrapped(orig=orig, prof=prof):
-                    prof.enable()
-                    try:
-                        orig()
-                    finally:
-                        prof.disable()
+        def wrapped(orig=orig, prof=prof):
+            prof.enable()
+            try:
+                orig()
+            finally:
+                prof.disable()
 
-                setattr(node.txflow, attr, wrapped)
+        setattr(node.txflow, attr, wrapped)
 
     txs = [b"tx-%d=v" % i for i in range(n_txs)]
     votes_by_val: list[list[TxVote]] = [[] for _ in range(n_vals)]
@@ -141,12 +143,11 @@ def main() -> None:
         f"({committed} votes, {wall:.2f}s, {n_vals} validators, {n_txs} txs)"
     )
 
-    if do_profile:
-        merged = pstats.Stats(profilers[0])
-        for p in profilers[1:]:
-            merged.add(p)
-        merged.sort_stats("cumulative")
-        merged.print_stats(40)
+    if prof is not None:
+        stats = pstats.Stats(prof)
+        stats.sort_stats("cumulative")
+        stats.print_stats(40)
+        stats.dump_stats("/tmp/prof.out")
 
 
 if __name__ == "__main__":

@@ -357,3 +357,72 @@ def test_verifier_mux_stop_strands_no_callers():
     # served results must be correct; failures must be the shutdown error
     for kind, val in results:
         assert (kind == "ok" and val is True) or kind == "stopped", results
+
+
+def test_verify_cache_parity_and_sharing():
+    """Cached verifiers must make bit-identical decisions to the plain
+    scalar golden model, while co-located engines sharing one cache skip
+    re-verifying votes the first engine already resolved (r4: the 4-node
+    bench ran 4x redundant kernel work without this)."""
+    from txflow_tpu.verifier import VerifyCache
+
+    vals, seeds = make_valset(4)
+    golden = ScalarVoteVerifier(vals)
+    cache = VerifyCache()
+    eng_a = ScalarVoteVerifier(vals, shared_cache=cache)
+    eng_b = ScalarVoteVerifier(vals, shared_cache=cache)
+
+    msgs, sigs, vidx, slot = make_batch(
+        vals, seeds, n_txs=6,
+        corrupt=("ok", "flip", "ok", "wrongkey", "badidx", "ok"),
+    )
+    n_slots = 6
+    want = golden.verify_and_tally(msgs, sigs, vidx, slot, n_slots)
+    got_a = eng_a.verify_and_tally(msgs, sigs, vidx, slot, n_slots)
+    np.testing.assert_array_equal(want.valid, got_a.valid)
+    np.testing.assert_array_equal(want.stake, got_a.stake)
+    np.testing.assert_array_equal(want.maj23, got_a.maj23)
+    np.testing.assert_array_equal(want.dropped, got_a.dropped)
+
+    # second engine, same gossip: all cacheable rows must hit
+    before_misses = cache.misses
+    got_b = eng_b.verify_and_tally(msgs, sigs, vidx, slot, n_slots)
+    np.testing.assert_array_equal(want.valid, got_b.valid)
+    np.testing.assert_array_equal(want.maj23, got_b.maj23)
+    assert cache.misses == before_misses, "engine B re-verified cached votes"
+    assert cache.hits > 0
+
+    # key binds the message: replaying a cached-valid signature on a
+    # DIFFERENT payload must NOT alias to the cached verdict
+    forged_msgs = [m + b"X" for m in msgs]
+    got_forged = eng_b.verify_and_tally(forged_msgs, sigs, vidx, slot, n_slots)
+    assert not got_forged.valid.any()
+
+
+def test_device_verifier_cached_parity(device_verifier_factory=None):
+    """Device verifier with the cache on: decisions identical to both the
+    plain device kernel and the scalar golden model; second call all-hits."""
+    vals, seeds = make_valset(4)
+    golden = ScalarVoteVerifier(vals)
+    dev = DeviceVoteVerifier(vals, shared_cache=True)
+    msgs, sigs, vidx, slot = make_batch(
+        vals, seeds, n_txs=5, corrupt=("ok", "flip", "ok", "wrongkey")
+    )
+    n_slots = 5
+    want = golden.verify_and_tally(msgs, sigs, vidx, slot, n_slots)
+    got = dev.verify_and_tally(msgs, sigs, vidx, slot, n_slots)
+    np.testing.assert_array_equal(want.valid, got.valid)
+    np.testing.assert_array_equal(want.stake, got.stake)
+    np.testing.assert_array_equal(want.maj23, got.maj23)
+    np.testing.assert_array_equal(want.dropped, got.dropped)
+    before = dev.cache.misses
+    got2 = dev.verify_and_tally(msgs, sigs, vidx, slot, n_slots)
+    np.testing.assert_array_equal(want.valid, got2.valid)
+    assert dev.cache.misses == before
+
+    # prior stake must latch through the cached host tally as well
+    prior = np.array([vals.quorum_power() - 10] + [0] * (n_slots - 1), np.int64)
+    got3 = dev.verify_and_tally(msgs, sigs, vidx, slot, n_slots, prior_stake=prior)
+    want3 = golden.verify_and_tally(msgs, sigs, vidx, slot, n_slots, prior_stake=prior)
+    np.testing.assert_array_equal(want3.stake, got3.stake)
+    np.testing.assert_array_equal(want3.maj23, got3.maj23)

@@ -1,0 +1,220 @@
+"""ABCI socket client: drive an out-of-process app from the node.
+
+Node-side half of the process boundary (reference node/node.go:576;
+abci/client socket client semantics): three sockets — mempool, consensus,
+query — each with ordered request/response streams. Async methods WRITE
+the request and return a placeholder immediately; ``flush()`` sends the
+Flush fence and resolves every placeholder in order when the fence's
+response arrives. That is exactly the reference's DeliverTxAsync-then-
+Flush shape (txflowstate/execution.go:169-185), so ``TxExecutor`` and
+``BlockExecutor`` run unmodified against a remote app.
+
+``RemoteAppConns(addr)`` is a drop-in for ``AppConns(app)``.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from dataclasses import dataclass
+
+from . import wire
+
+
+@dataclass
+class _Pending:
+    kind: int
+    result: object = None  # mirrors proxy._Result.value
+    resolved: bool = False
+
+
+class _SocketConn:
+    """One ordered ABCI connection over one socket."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.settimeout(timeout)
+        self._wf = self._sock.makefile("wb")
+        self._mtx = threading.RLock()  # serializes request writes + reads
+        self._pending: list[_Pending] = []
+        self._error: Exception | None = None
+
+    def error(self) -> Exception | None:
+        return self._error
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- plumbing --
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("abci server closed")
+            buf += chunk
+        return buf
+
+    def _send(self, payload: bytes, flush: bool = False) -> None:
+        self._wf.write(wire.frame(payload))
+        if flush:
+            self._wf.flush()
+
+    def _read_response(self, want_kind: int):
+        payload = wire.read_frame(self._read_exact)
+        kind, res = wire.decode_response(payload)
+        if kind == wire.EXCEPTION:
+            raise res
+        if kind != want_kind:
+            raise ValueError(
+                f"abci response kind {kind} for request kind {want_kind}"
+            )
+        return res
+
+    def _call_sync(self, payload: bytes, kind: int):
+        """Write + drain pending + read this call's response (a sync call
+        is itself a fence for previously pipelined async requests)."""
+        with self._mtx:
+            try:
+                self._send(payload, flush=True)
+                self._drain_pending()
+                return self._read_response(kind)
+            except Exception as e:
+                self._error = e
+                raise
+
+    def _call_async(self, payload: bytes, kind: int) -> _Pending:
+        p = _Pending(kind)
+        with self._mtx:
+            try:
+                self._send(payload)
+                self._pending.append(p)
+            except Exception as e:
+                self._error = e
+                raise
+        return p
+
+    def _drain_pending(self) -> None:
+        pending, self._pending = self._pending, []
+        for p in pending:
+            p.result = self._read_response(p.kind)
+            p.resolved = True
+
+    def flush(self) -> None:
+        """The pipeline fence: resolves every async placeholder."""
+        with self._mtx:
+            try:
+                self._send(wire.encode_request(wire.FLUSH), flush=True)
+                self._drain_pending()
+                self._read_response(wire.FLUSH)
+            except Exception as e:
+                self._error = e
+                raise
+
+    def echo(self, msg: bytes) -> bytes:
+        return self._call_sync(wire.encode_request(wire.ECHO, raw=msg), wire.ECHO)
+
+
+class _AsyncResult:
+    """Duck-typed like proxy._Result — ``.value`` is ALWAYS readable.
+
+    The in-process proxy resolves async results inline, and existing
+    callers rely on that (BlockExecutor reads ``.value`` per tx before
+    its flush, state/execution.py). Over the socket the result only
+    exists after a fence, so reading an unresolved ``.value`` forces the
+    flush fence first: callers that fence explicitly keep full
+    pipelining; callers that read eagerly serialize, exactly like the
+    in-process proxy."""
+
+    __slots__ = ("_p", "_conn")
+
+    def __init__(self, p: _Pending, conn: "_SocketConn"):
+        self._p = p
+        self._conn = conn
+
+    @property
+    def value(self):
+        if not self._p.resolved:
+            self._conn.flush()
+        return self._p.result
+
+
+class AppConnMempool(_SocketConn):
+    def check_tx_sync(self, tx: bytes):
+        return self._call_sync(
+            wire.encode_request(wire.CHECK_TX, raw=tx), wire.CHECK_TX
+        )
+
+    def check_tx_async(self, tx: bytes, callback=None) -> _AsyncResult:
+        p = self._call_async(wire.encode_request(wire.CHECK_TX, raw=tx), wire.CHECK_TX)
+        if callback is not None:
+            # callbacks fire at the flush fence, in submit order
+            self.flush()
+            callback(p.result)
+        return _AsyncResult(p, self)
+
+
+class AppConnConsensus(_SocketConn):
+    def init_chain_sync(self, validators: list) -> None:
+        self._call_sync(
+            wire.encode_request(wire.INIT_CHAIN, validators=validators),
+            wire.INIT_CHAIN,
+        )
+
+    def begin_block_sync(self, req) -> None:
+        self._call_sync(
+            wire.encode_request(wire.BEGIN_BLOCK, req=req), wire.BEGIN_BLOCK
+        )
+
+    def deliver_tx_async(self, tx: bytes, callback=None) -> _AsyncResult:
+        p = self._call_async(
+            wire.encode_request(wire.DELIVER_TX, raw=tx), wire.DELIVER_TX
+        )
+        if callback is not None:
+            self.flush()
+            callback(p.result)
+        return _AsyncResult(p, self)
+
+    def end_block_sync(self, req):
+        return self._call_sync(
+            wire.encode_request(wire.END_BLOCK, height=req.height), wire.END_BLOCK
+        )
+
+    def commit_sync(self):
+        return self._call_sync(wire.encode_request(wire.COMMIT), wire.COMMIT)
+
+
+class AppConnQuery(_SocketConn):
+    def info_sync(self):
+        return self._call_sync(wire.encode_request(wire.INFO), wire.INFO)
+
+    def query_sync(self, path: str, data: bytes):
+        return self._call_sync(
+            wire.encode_request(wire.QUERY, path=path, raw=data), wire.QUERY
+        )
+
+
+class RemoteAppConns:
+    """Drop-in for ``proxy.AppConns`` over a socket ABCI server.
+
+    app attribute is None — the app lives in another process; callers that
+    introspect ``.app`` (tests, localnet conveniences) must use the query
+    connection instead.
+    """
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        host, port_s = addr.rsplit(":", 1)
+        port = int(port_s)
+        self.app = None
+        self.mempool = AppConnMempool(host, port, timeout)
+        self.consensus = AppConnConsensus(host, port, timeout)
+        self.query = AppConnQuery(host, port, timeout)
+
+    def close(self) -> None:
+        self.mempool.close()
+        self.consensus.close()
+        self.query.close()

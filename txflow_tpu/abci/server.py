@@ -1,0 +1,185 @@
+"""ABCI socket server: serve one Application to out-of-process nodes.
+
+The app side of the process boundary the reference opens at node start
+(reference node/node.go:576 createAndStartProxyAppConns; the executors
+then drive the app remotely, txflowstate/execution.go:161-185). A node
+connects one socket per logical connection (mempool / consensus / query);
+requests on one connection are served strictly in order and responses are
+written back in the same order, so async pipelining + the Flush fence
+behave exactly like the in-process proxy. Calls across connections are
+serialized by one app lock, matching ``AppConns``' ordering contract.
+
+Run standalone:  python -m txflow_tpu.abci.server --app kvstore \
+                        --addr 127.0.0.1:26658
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from . import wire
+from .application import Application
+
+
+class ABCIServer:
+    def __init__(self, app: Application, host: str = "127.0.0.1", port: int = 0):
+        self.app = app
+        self._app_lock = threading.RLock()
+        self._listener = socket.create_server((host, port))
+        self.addr = self._listener.getsockname()
+        self._accept_thread: threading.Thread | None = None
+        self._running = False
+        self._conns: list[socket.socket] = []
+        self._mtx = threading.Lock()
+
+    def start(self) -> None:
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="abci-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._mtx:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._mtx:
+                self._conns.append(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), name="abci-conn",
+                daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        def read_exact(n: int) -> bytes:
+            buf = b""
+            while len(buf) < n:
+                chunk = conn.recv(n - len(buf))
+                if not chunk:
+                    raise ConnectionError("abci peer closed")
+                buf += chunk
+            return buf
+
+        import queue
+
+        # Dedicated writer: a single read-then-write loop deadlocks on
+        # large pipelined bursts — once the outbound socket buffer fills
+        # with unread responses the server stops reading, the client's
+        # send then blocks too, and both sides wedge (the reference's
+        # socket server runs a separate write routine for the same
+        # reason). The writer also owns flushing: it batches while more
+        # responses are queued and flushes when the queue idles.
+        out = conn.makefile("wb")
+        wq: queue.SimpleQueue = queue.SimpleQueue()
+
+        def writer() -> None:
+            try:
+                while True:
+                    frame = wq.get()
+                    if frame is None:
+                        return
+                    out.write(frame)
+                    if wq.empty():
+                        out.flush()
+            except (ConnectionError, OSError, ValueError):
+                try:
+                    conn.close()  # unblock the reader loop too
+                except OSError:
+                    pass
+
+        wt = threading.Thread(target=writer, name="abci-writer", daemon=True)
+        wt.start()
+        try:
+            while True:
+                payload = wire.read_frame(read_exact)
+                kind, fields = wire.decode_request(payload)
+                try:
+                    resp = self._dispatch(kind, fields)
+                except Exception as e:  # app raised: report, keep serving
+                    wq.put(wire.frame(wire.encode_response(wire.EXCEPTION, e)))
+                    continue
+                wq.put(wire.frame(wire.encode_response(kind, resp)))
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            wq.put(None)
+            wt.join(timeout=5)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, kind: int, fields: dict):
+        app = self.app
+        with self._app_lock:
+            if kind == wire.ECHO:
+                return fields["raw"]
+            if kind == wire.FLUSH:
+                return None
+            if kind == wire.INFO:
+                return app.info()
+            if kind == wire.INIT_CHAIN:
+                app.init_chain(fields["validators"])
+                return None
+            if kind == wire.CHECK_TX:
+                return app.check_tx(fields["raw"])
+            if kind == wire.BEGIN_BLOCK:
+                app.begin_block(fields["req"])
+                return None
+            if kind == wire.DELIVER_TX:
+                return app.deliver_tx(fields["raw"])
+            if kind == wire.END_BLOCK:
+                from .types import RequestEndBlock
+
+                return app.end_block(RequestEndBlock(height=fields["height"]))
+            if kind == wire.COMMIT:
+                return app.commit()
+            if kind == wire.QUERY:
+                return app.query(fields["path"], fields["raw"])
+        raise ValueError(f"unknown request kind {kind}")
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="serve an ABCI app over a socket")
+    p.add_argument("--app", default="kvstore", choices=("kvstore", "counter"))
+    p.add_argument("--addr", default="127.0.0.1:26658")
+    args = p.parse_args(argv)
+    host, port = args.addr.rsplit(":", 1)
+    if args.app == "kvstore":
+        from .kvstore import KVStoreApplication
+
+        app = KVStoreApplication()
+    else:
+        from .counter import CounterApplication
+
+        app = CounterApplication()
+    srv = ABCIServer(app, host, int(port))
+    srv.start()
+    print(f"abci: serving {args.app} on {srv.addr[0]}:{srv.addr[1]}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,286 @@
+"""ABCI socket wire format (framework-native).
+
+The reference drives apps across a process boundary through socket ABCI
+connections created at node start (reference node/node.go:576,
+createAndStartProxyAppConns) — apps in other processes/languages are the
+point of ABCI. This rebuild's wire format keeps the same shape — varint
+length-delimited frames, one request kind per method, a Flush fence —
+but encodes with the framework's own deterministic codec primitives
+instead of protobuf: frame = uvarint(len(payload)) ++ payload, payload =
+kind byte ++ fields (length-prefixed where variable).
+
+Messages (kind byte shared between request and response; EXCEPTION only
+appears in responses):
+
+| kind | request payload                      | response payload |
+|------|--------------------------------------|------------------|
+| 0    | — (EXCEPTION)                        | lp(error string) |
+| 1    | ECHO: msg                            | msg              |
+| 2    | FLUSH: empty                         | empty            |
+| 3    | INFO: empty                          | lp(data) lp(version) uv(height) lp(app_hash) |
+| 4    | INIT_CHAIN: uv(n) [lp(pub) uv(pow)]* | empty            |
+| 5    | CHECK_TX: tx                         | uv(code) lp(data) lp(log) uv(gas) |
+| 6    | BEGIN_BLOCK: lp(hash) uv(height) lp(proposer) uv(n) [lp(addr) uv(h)]* | empty |
+| 7    | DELIVER_TX: tx                       | uv(code) lp(data) lp(log) uv(n) [lp(k) lp(v)]* |
+| 8    | END_BLOCK: uv(height)                | uv(n) [lp(pub) uv(pow)]* |
+| 9    | COMMIT: empty                        | lp(app_hash)     |
+| 10   | QUERY: lp(path) data                 | uv(code) lp(key) lp(value) lp(log) uv(height) |
+"""
+
+from __future__ import annotations
+
+from ..codec.amino import length_prefixed, read_uvarint, uvarint
+from .types import (
+    RequestBeginBlock,
+    RequestEndBlock,
+    ResponseCheckTx,
+    ResponseCommit,
+    ResponseDeliverTx,
+    ResponseEndBlock,
+    ResponseInfo,
+    ResponseQuery,
+    ValidatorUpdate,
+)
+
+EXCEPTION = 0
+ECHO = 1
+FLUSH = 2
+INFO = 3
+INIT_CHAIN = 4
+CHECK_TX = 5
+BEGIN_BLOCK = 6
+DELIVER_TX = 7
+END_BLOCK = 8
+COMMIT = 9
+QUERY = 10
+
+MAX_FRAME = 8 << 20  # an oversized frame is a protocol violation, not an OOM
+
+
+def frame(payload: bytes) -> bytes:
+    return uvarint(len(payload)) + payload
+
+
+def read_frame(read_exact) -> bytes:
+    """Read one frame via read_exact(n) -> bytes (raises on EOF)."""
+    # uvarint length, byte at a time (length prefixes are tiny)
+    shift = 0
+    n = 0
+    while True:
+        b = read_exact(1)[0]
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+        if shift > 35:
+            raise ValueError("frame length varint too long")
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large ({n} bytes)")
+    return read_exact(n) if n else b""
+
+
+def _lp_read(data: bytes, off: int) -> tuple[bytes, int]:
+    n, off = read_uvarint(data, off)
+    if off + n > len(data):
+        raise ValueError("truncated length-prefixed field")
+    return data[off : off + n], off + n
+
+
+# -- requests --
+
+
+def encode_request(kind: int, *, raw: bytes = b"", **kw) -> bytes:
+    if kind in (ECHO, CHECK_TX, DELIVER_TX):
+        return bytes([kind]) + raw
+    if kind in (FLUSH, INFO, COMMIT):
+        return bytes([kind])
+    if kind == INIT_CHAIN:
+        out = bytearray([kind])
+        vals = kw["validators"]
+        out += uvarint(len(vals))
+        for v in vals:  # ValidatorUpdate-like or (pub, power) pair
+            pub, power = (
+                (v.pub_key, v.power) if hasattr(v, "pub_key") else v
+            )
+            out += length_prefixed(pub) + uvarint(power)
+        return bytes(out)
+    if kind == BEGIN_BLOCK:
+        req: RequestBeginBlock = kw["req"]
+        out = bytearray([kind])
+        out += length_prefixed(req.hash)
+        out += uvarint(req.height)
+        out += length_prefixed(req.proposer_address)
+        out += uvarint(len(req.byzantine_validators))
+        for addr, h in req.byzantine_validators:
+            out += length_prefixed(addr) + uvarint(h)
+        return bytes(out)
+    if kind == END_BLOCK:
+        return bytes([kind]) + uvarint(kw["height"])
+    if kind == QUERY:
+        return bytes([kind]) + length_prefixed(kw["path"].encode()) + raw
+    raise ValueError(f"unknown request kind {kind}")
+
+
+def decode_request(payload: bytes):
+    """-> (kind, dict of fields). Raises ValueError on malformed input
+    (peer-facing decoder: total, never IndexError)."""
+    if not payload:
+        raise ValueError("empty request")
+    kind, body = payload[0], payload[1:]
+    if kind in (ECHO, CHECK_TX, DELIVER_TX):
+        return kind, {"raw": body}
+    if kind in (FLUSH, INFO, COMMIT):
+        return kind, {}
+    if kind == INIT_CHAIN:
+        n, off = read_uvarint(body, 0)
+        vals = []
+        for _ in range(n):
+            pub, off = _lp_read(body, off)
+            power, off = read_uvarint(body, off)
+            vals.append(ValidatorUpdate(pub, power))
+        return kind, {"validators": vals}
+    if kind == BEGIN_BLOCK:
+        hash_, off = _lp_read(body, 0)
+        height, off = read_uvarint(body, off)
+        proposer, off = _lp_read(body, off)
+        n, off = read_uvarint(body, off)
+        byz = []
+        for _ in range(n):
+            addr, off = _lp_read(body, off)
+            h, off = read_uvarint(body, off)
+            byz.append((addr, h))
+        return kind, {
+            "req": RequestBeginBlock(
+                hash=hash_, height=height, proposer_address=proposer,
+                byzantine_validators=byz,
+            )
+        }
+    if kind == END_BLOCK:
+        height, _ = read_uvarint(body, 0)
+        return kind, {"height": height}
+    if kind == QUERY:
+        path, off = _lp_read(body, 0)
+        return kind, {"path": path.decode(), "raw": body[off:]}
+    raise ValueError(f"unknown request kind {kind}")
+
+
+# -- responses --
+
+
+def encode_response(kind: int, res) -> bytes:
+    if kind == EXCEPTION:
+        return bytes([kind]) + length_prefixed(str(res).encode())
+    if kind == ECHO:
+        return bytes([kind]) + res
+    if kind in (FLUSH, INIT_CHAIN, BEGIN_BLOCK):
+        return bytes([kind])
+    if kind == INFO:
+        return (
+            bytes([kind])
+            + length_prefixed(res.data.encode())
+            + length_prefixed(res.version.encode())
+            + uvarint(res.last_block_height)
+            + length_prefixed(res.last_block_app_hash)
+        )
+    if kind == CHECK_TX:
+        return (
+            bytes([kind])
+            + uvarint(res.code)
+            + length_prefixed(res.data or b"")
+            + length_prefixed(res.log.encode())
+            + uvarint(res.gas_wanted)
+        )
+    if kind == DELIVER_TX:
+        out = bytearray([kind])
+        out += uvarint(res.code)
+        out += length_prefixed(res.data or b"")
+        out += length_prefixed(res.log.encode())
+        tags = list(getattr(res, "tags", []) or [])
+        out += uvarint(len(tags))
+        for k, v in tags:
+            out += length_prefixed(bytes(k)) + length_prefixed(bytes(v))
+        return bytes(out)
+    if kind == END_BLOCK:
+        out = bytearray([kind])
+        ups = res.validator_updates
+        out += uvarint(len(ups))
+        for u in ups:
+            out += length_prefixed(u.pub_key) + uvarint(u.power)
+        return bytes(out)
+    if kind == COMMIT:
+        return bytes([kind]) + length_prefixed(res.data or b"")
+    if kind == QUERY:
+        return (
+            bytes([kind])
+            + uvarint(res.code)
+            + length_prefixed(res.key or b"")
+            + length_prefixed(res.value or b"")
+            + length_prefixed(res.log.encode())
+            + uvarint(res.height)
+        )
+    raise ValueError(f"unknown response kind {kind}")
+
+
+def decode_response(payload: bytes):
+    """-> (kind, response object). EXCEPTION decodes to RuntimeError."""
+    if not payload:
+        raise ValueError("empty response")
+    kind, body = payload[0], payload[1:]
+    if kind == EXCEPTION:
+        msg, _ = _lp_read(body, 0)
+        return kind, RuntimeError(f"remote ABCI app: {msg.decode()}")
+    if kind == ECHO:
+        return kind, body
+    if kind in (FLUSH, INIT_CHAIN, BEGIN_BLOCK):
+        return kind, None
+    if kind == INFO:
+        data, off = _lp_read(body, 0)
+        version, off = _lp_read(body, off)
+        height, off = read_uvarint(body, off)
+        app_hash, _ = _lp_read(body, off)
+        return kind, ResponseInfo(
+            data=data.decode(), version=version.decode(),
+            last_block_height=height, last_block_app_hash=app_hash,
+        )
+    if kind == CHECK_TX:
+        code, off = read_uvarint(body, 0)
+        data, off = _lp_read(body, off)
+        log, off = _lp_read(body, off)
+        gas, _ = read_uvarint(body, off)
+        return kind, ResponseCheckTx(
+            code=code, data=data, log=log.decode(), gas_wanted=gas
+        )
+    if kind == DELIVER_TX:
+        code, off = read_uvarint(body, 0)
+        data, off = _lp_read(body, off)
+        log, off = _lp_read(body, off)
+        n, off = read_uvarint(body, off)
+        tags = []
+        for _ in range(n):
+            k, off = _lp_read(body, off)
+            v, off = _lp_read(body, off)
+            tags.append((k, v))
+        return kind, ResponseDeliverTx(
+            code=code, data=data, log=log.decode(), tags=tags
+        )
+    if kind == END_BLOCK:
+        n, off = read_uvarint(body, 0)
+        ups = []
+        for _ in range(n):
+            pub, off = _lp_read(body, off)
+            power, off = read_uvarint(body, off)
+            ups.append(ValidatorUpdate(pub, power))
+        return kind, ResponseEndBlock(validator_updates=ups)
+    if kind == COMMIT:
+        data, _ = _lp_read(body, 0)
+        return kind, ResponseCommit(data=data)
+    if kind == QUERY:
+        code, off = read_uvarint(body, 0)
+        key, off = _lp_read(body, off)
+        value, off = _lp_read(body, off)
+        log, off = _lp_read(body, off)
+        height, _ = read_uvarint(body, off)
+        return kind, ResponseQuery(
+            code=code, key=key, value=value, log=log.decode(), height=height
+        )
+    raise ValueError(f"unknown response kind {kind}")

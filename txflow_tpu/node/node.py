@@ -110,9 +110,19 @@ class Node:
         self._last_block_height = self.chain_state.last_block_height
         self._val_set = self.chain_state.validators
 
-        # -- app + proxy (node/node.go:576) --
-        self.app = app
-        self.proxy_app = AppConns(app)
+        # -- app + proxy (node/node.go:576). An address string instead of
+        # an Application instance crosses the process boundary: the app
+        # runs elsewhere behind abci.server.ABCIServer and the node drives
+        # it over the socket protocol (abci/wire.py) — the reference's
+        # createAndStartProxyAppConns socket mode --
+        if isinstance(app, str):
+            from ..abci.client import RemoteAppConns
+
+            self.app = None
+            self.proxy_app = RemoteAppConns(app)
+        else:
+            self.app = app
+            self.proxy_app = AppConns(app)
 
         # -- event bus + tx indexer service (node/node.go:585, :211-238).
         # The indexer follows the reference's config gate (index rows are
@@ -350,6 +360,8 @@ class Node:
         self.switch.stop()
         self.mempool.close_wal()
         self.tx_vote_pool.close_wal()
+        if hasattr(self.proxy_app, "close"):  # remote ABCI sockets
+            self.proxy_app.close()
 
     # -- client surface (RPC broadcast_tx analog until the HTTP layer lands) --
 
