@@ -235,7 +235,7 @@ def test_ingest_log_compaction_bounds_memory():
             if i % 3 == 2:
                 items, cursor = pool.entries_from(cursor, limit=1000)
                 seen += len(items)
-                pool.remove([k for k, _, _ in items])
+                pool.remove([k for k, _, _, _ in items])
         items, cursor = pool.entries_from(cursor, limit=1000)
         seen += len(items)
         assert seen == 200

@@ -291,3 +291,110 @@ def test_broadcast_tx_commit():
         assert res["hash"] == hashlib.sha256(b"btc-k=v").hexdigest().upper()
     finally:
         net.stop()
+
+
+def test_rpc_route_parity():
+    """The reference's rpccore.Routes surface (node/node.go:898-986):
+    /commit for light-client certificate flows, /genesis, /net_info,
+    /block_results, /unconfirmed_txs, /num_unconfirmed_txs,
+    /consensus_state, /dump_consensus_state, /broadcast_evidence."""
+    cfg = make_test_config()
+    cfg.consensus.skip_timeout_commit = True
+    net = LocalNet(
+        4, use_device_verifier=False, enable_consensus=True, config=cfg, rpc=True
+    )
+    net.start()
+    try:
+        addr0 = net.nodes[0].rpc.addr
+
+        # drive one tx through so a block commits
+        res = rpc_get(addr0, '/broadcast_tx?tx="parity-k=v"')["result"]
+        assert rpc_get(addr0, f"/subscribe_tx?hash={res['hash']}&timeout=30")[
+            "result"
+        ]["committed"]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if rpc_get(addr0, "/blockchain")["result"]["height"] >= 1:
+                break
+            time.sleep(0.05)
+
+        # /genesis
+        gen = rpc_get(addr0, "/genesis")["result"]["genesis"]
+        assert gen["chain_id"] == "txflow-localnet"
+        assert len(gen["validators"]) == 4
+
+        # /net_info: full mesh = 3 peers
+        ni = rpc_get(addr0, "/net_info")["result"]
+        assert ni["n_peers"] == 3 and len(ni["peers"]) == 3
+
+        # /commit: header + sealing commit, signatures verifiable
+        cm = rpc_get(addr0, "/commit?height=1")["result"]
+        assert cm["header"]["height"] == 1
+        assert cm["commit"]["block_id"] == cm["block_id"]
+        assert len(cm["commit"]["precommits"]) >= 3  # quorum of 4
+        from txflow_tpu.types.block_vote import BlockVote, PRECOMMIT
+
+        for pc in cm["commit"]["precommits"]:
+            v = BlockVote(
+                height=pc["height"], round=pc["round"], type=PRECOMMIT,
+                block_id=bytes.fromhex(pc["block_id"]),
+                timestamp_ns=pc["timestamp_ns"],
+                validator_address=bytes.fromhex(pc["validator_address"]),
+                signature=bytes.fromhex(pc["signature"]),
+            )
+            _, val = net.val_set.get_by_address(v.validator_address)
+            assert val is not None and v.verify("txflow-localnet", val.pub_key)
+
+        # /block_results: persisted ABCI responses for the block
+        br = rpc_get(addr0, "/block_results?height=1")["result"]
+        assert br["height"] == 1 and isinstance(br["deliver_tx"], list)
+
+        # /unconfirmed_txs + /num_unconfirmed_txs: the tx may fast-commit
+        # between inject and query (signing nodes vote immediately), so
+        # accept EITHER pending-visible or already-committed
+        tx = b"pending-tx=1"
+        net.nodes[0].mempool.check_tx(tx)
+        ut = rpc_get(addr0, "/unconfirmed_txs?limit=10")["result"]
+        assert {"n_txs", "total", "total_bytes", "txs"} <= set(ut)
+        in_pool = any(bytes.fromhex(t) == tx for t in ut["txs"])
+        committed = net.nodes[0].txflow.is_tx_committed(
+            hashlib.sha256(tx).hexdigest().upper()
+        )
+        assert in_pool or committed, (ut, committed)
+        nut = rpc_get(addr0, "/num_unconfirmed_txs")["result"]
+        assert "total" in nut and "vote_pool" in nut
+
+        # /consensus_state + /dump_consensus_state
+        cs = rpc_get(addr0, "/consensus_state")["result"]["round_state"]
+        assert cs["height"] >= 1 and "step" in cs
+        dcs = rpc_get(addr0, "/dump_consensus_state")["result"]["round_state"]
+        assert "votes" in dcs and len(dcs["validators"]) == 4
+
+        # /broadcast_evidence: a real equivocation proof is admitted and
+        # gossiped; garbage is rejected
+        from txflow_tpu.types.block_vote import PREVOTE
+        from txflow_tpu.types.evidence import (
+            DuplicateBlockVoteEvidence,
+            encode_evidence,
+        )
+
+        pv = net.priv_vals[1]
+        votes = []
+        for bid in (b"\x01" * 20, b"\x02" * 20):
+            bv = BlockVote(height=1, round=0, type=PREVOTE, block_id=bid,
+                           validator_address=pv.get_address())
+            pv.sign_block_vote("txflow-localnet", bv)
+            votes.append(bv)
+        ev = DuplicateBlockVoteEvidence(*votes)
+        out = rpc_get(
+            addr0, f"/broadcast_evidence?evidence={encode_evidence(ev).hex()}"
+        )["result"]
+        assert out["added"] is True
+        assert net.nodes[0].evidence_pool.has(ev)
+        try:
+            rpc_get(addr0, "/broadcast_evidence?evidence=ffff")
+            assert False, "expected HTTPError"
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+    finally:
+        net.stop()

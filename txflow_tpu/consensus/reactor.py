@@ -69,6 +69,12 @@ class ConsensusReactor(Reactor):
         consensus.broadcast_vote = self._broadcast_vote
         consensus.broadcast_step = self._broadcast_step
         self._gossip_stop = threading.Event()
+        # encoded-proposal cache: gossip re-offers the SAME proposal to
+        # same-height peers every tick, and each encode walks the whole
+        # block's tx lists (r4 config-5 profile: block re-encoding was
+        # the single largest fast-path/block-path interference cost)
+        self._prop_cache_key: tuple | None = None
+        self._prop_cache_msg: bytes = b""
 
     def get_channels(self) -> list[ChannelDescriptor]:
         # priority 6 (above the bulk txvote/mempool channels) and reliable:
@@ -101,10 +107,19 @@ class ConsensusReactor(Reactor):
 
     # -- outbound (hooks called by ConsensusState) --
 
+    def _encoded_proposal(self, p: Proposal, block: Block) -> bytes:
+        key = (p.height, p.round, p.block_hash)
+        if self._prop_cache_key == key:
+            return self._prop_cache_msg
+        msg = _encode_proposal_msg(p, block)
+        self._prop_cache_key = key
+        self._prop_cache_msg = msg
+        return msg
+
     def _broadcast_proposal(self, p: Proposal, block: Block) -> None:
         if self.switch is not None:
             self.switch.broadcast(
-                CHANNEL_CONSENSUS_STATE, _encode_proposal_msg(p, block)
+                CHANNEL_CONSENSUS_STATE, self._encoded_proposal(p, block)
             )
 
     def _broadcast_vote(self, vote: BlockVote) -> None:
@@ -219,7 +234,7 @@ class ConsensusReactor(Reactor):
             votes = [v for v in votes if v.round == rs.round]
         if with_block and proposal is not None and block is not None:
             peer.try_send(
-                CHANNEL_CONSENSUS_STATE, _encode_proposal_msg(proposal, block)
+                CHANNEL_CONSENSUS_STATE, self._encoded_proposal(proposal, block)
             )
         for v in votes:
             peer.try_send(

@@ -22,6 +22,7 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 
+from ..codec import amino
 from ..crypto.hash import sha256
 from ..types import TxVote, decode_tx_vote, encode_tx_vote
 from ..utils.cache import LRUCache, NopCache
@@ -48,6 +49,10 @@ class _PoolVote:
     vote: TxVote
     senders: set[int] = field(default_factory=set)
     size: int = 0  # encoded wire size, cached so removals never re-encode
+    # uvarint-length-prefixed wire form, built once at ingest: the gossip
+    # batch frame is a plain b"".join of these, so per-peer broadcast
+    # walks never re-serialize (r4 profile: lp+append per vote per peer)
+    seg: bytes = b""
 
 
 class TxVotePool(IngestLogPool):
@@ -161,7 +166,10 @@ class TxVotePool(IngestLogPool):
                 raise ErrTxInCache()
             if self.wal is not None and write_wal:
                 self.wal.write(encoded)
-            entry = _PoolVote(self.height, vote, {tx_info.sender_id}, vote_size)
+            entry = _PoolVote(
+                self.height, vote, {tx_info.sender_id}, vote_size,
+                seg=amino.length_prefixed(encoded),
+            )
             self._votes[key] = entry
             self._log_append(key)
             self._votes_bytes += vote_size
@@ -202,11 +210,11 @@ class TxVotePool(IngestLogPool):
 
     def entries_from(
         self, cursor: int, limit: int = 256
-    ) -> tuple[list[tuple[bytes, TxVote, int]], int]:
-        """Stable-cursor walk of live votes: (key, vote, height) triples;
-        see IngestLogPool._entries_from for the cursor contract."""
+    ) -> tuple[list[tuple[bytes, TxVote, int, bytes]], int]:
+        """Stable-cursor walk of live votes: (key, vote, height, wire seg)
+        tuples; see IngestLogPool._entries_from for the cursor contract."""
         raw, pos = self._entries_from(cursor, limit)
-        return [(k, e.vote, e.height) for k, e in raw], pos
+        return [(k, e.vote, e.height, e.seg) for k, e in raw], pos
 
     def remove(self, keys: list[bytes], cache_too: bool = False) -> None:
         """Remove votes by key (quorum purge path)."""

@@ -37,6 +37,7 @@ from ..types.validator import ValidatorSet
 
 MSG_VOTES = 1
 MSG_HEIGHT = 2
+_MSG_VOTES_B = bytes([MSG_VOTES])
 
 PEER_CATCHUP_SLEEP = 0.005  # reference peerCatchupSleepIntervalMS=100; faster here
 PEER_HEIGHT_KEY = "txvote_height"
@@ -60,6 +61,9 @@ def encode_vote_batch(votes: list[TxVote]) -> bytes:
 
 
 class TxVoteReactor(Reactor):
+    # process-wide decoded-vote cache (see __init__ comment)
+    _shared_wire = LRUMap(1 << 16)
+
     def __init__(
         self,
         get_state: Callable[[], StateView],
@@ -84,14 +88,19 @@ class TxVoteReactor(Reactor):
         self._ids_mtx = threading.Lock()
         self._threads: list[threading.Thread] = []
         self._sign_thread: threading.Thread | None = None
-        # wire-segment dedup: sha256(raw segment) -> pool vote key. Gossip
-        # delivers each vote ~2-3x (independent forwarders); decoding a dup
-        # just to have the pool's signature-dedup reject it measured ~12 us
-        # per duplicate (r3 profile). Canonical wire caching makes all
-        # forwarders emit identical bytes, so the raw segment IS a dedup
-        # key; non-canonical variants miss here and fall through to the
-        # pool's authoritative signature dedup.
-        self._seen_wire = LRUMap(1 << 16)
+        # wire-segment dedup + decoded-vote sharing: sha256(raw segment) ->
+        # (pool vote key, decoded TxVote). Gossip delivers each vote ~2-3x
+        # (independent forwarders) and, with co-located nodes, N nodes
+        # each decode the SAME canonical bytes (~10 us each, r3/r4
+        # profiles). Canonical wire caching makes all forwarders emit
+        # identical bytes, so the raw segment IS the key; the map is
+        # PROCESS-WIDE (class attribute) so the first node to decode a
+        # vote shares the immutable object with every other node —
+        # nothing downstream mutates pooled votes, and the key binds the
+        # exact bytes, so a hostile variant encoding simply misses and
+        # pays its own decode. Sender bookkeeping stays per-node in the
+        # pool; the pool's signature dedup remains authoritative.
+        self._seen_wire = TxVoteReactor._shared_wire
 
     # -- channels --
 
@@ -166,22 +175,27 @@ class TxVoteReactor(Reactor):
                 seg = r.read_bytes()  # decode error -> peer stopped
                 wk = sha256(seg)
                 hit = seen.get(wk)
-                if hit is not None and pool.add_sender(hit, pid):
-                    # dup AND the pool still holds it: skip decode entirely.
-                    # If the pool dropped it (purge/flush/eviction), fall
-                    # through to the authoritative decode + check_tx path —
-                    # the wire cache must never overrule the pool's own
-                    # re-accept policy (r3 review finding).
-                    continue
-                vote = decode_tx_vote(seg)
+                if hit is not None:
+                    vk, vote = hit
+                    if pool.add_sender(vk, pid):
+                        # dup AND the pool still holds it: nothing to do.
+                        # If the pool dropped it (purge/flush/eviction),
+                        # fall through to the authoritative check_tx path
+                        # — the wire cache must never overrule the pool's
+                        # own re-accept policy (r3 review finding) — but
+                        # reuse the shared decoded object either way.
+                        continue
+                else:
+                    vote = decode_tx_vote(seg)
+                    vk = vote.vote_key()
                 try:
                     pool.check_tx(vote, TxInfo(sender_id=pid))
                 except ErrTxInCache:
-                    seen.put(wk, vote.vote_key())
+                    seen.put(wk, (vk, vote))
                     continue  # reference logs and moves on
                 except (ErrMempoolIsFull, ErrTxTooLarge):
                     continue
-                seen.put(wk, vote.vote_key())
+                seen.put(wk, (vk, vote))
         elif msg_type == MSG_HEIGHT:
             height, _ = amino.read_uvarint(msg, 1)
             peer.set(PEER_HEIGHT_KEY, height)
@@ -230,7 +244,7 @@ class TxVoteReactor(Reactor):
     def _broadcast_routine(self, peer) -> None:
         pid = self._peer_id(peer)
         cursor = 0
-        pending: list[tuple[bytes, TxVote, int]] = []
+        pending: list[tuple[bytes, TxVote, int, bytes]] = []
         seq = self.tx_vote_pool.seq()
         while self._running.is_set() and peer.is_running():
             if not pending:
@@ -242,13 +256,16 @@ class TxVoteReactor(Reactor):
                 continue
             peer_height = peer.get(PEER_HEIGHT_KEY, 0)
             sendable, deferred = [], []
-            for key, vote, _h in pending:
+            for key, vote, _h, seg in pending:
                 if vote.height - 1 > peer_height:  # allow a lag of 1 block
-                    deferred.append((key, vote, _h))
+                    deferred.append((key, vote, _h, seg))
                 elif not self.tx_vote_pool.has_sender(key, pid):
-                    sendable.append(vote)
+                    sendable.append(seg)
             if sendable:
-                if not peer.send(CHANNEL_TXVOTE, encode_vote_batch(sendable)):
+                # the frame is a join of ingest-time cached segments: the
+                # per-peer walk never re-serializes a vote (r4 profile)
+                frame = _MSG_VOTES_B + b"".join(sendable)
+                if not peer.send(CHANNEL_TXVOTE, frame):
                     time.sleep(PEER_CATCHUP_SLEEP)
                     continue  # retry the same batch
             pending = deferred

@@ -147,6 +147,16 @@ class RPCServer:
             "/tx_search": self._tx_search,
             "/metrics": self._metrics,
             "/health": lambda q: {},
+            # rpccore.Routes parity (reference node/node.go:898-986)
+            "/commit": self._commit,
+            "/genesis": self._genesis,
+            "/net_info": self._net_info,
+            "/block_results": self._block_results,
+            "/unconfirmed_txs": self._unconfirmed_txs,
+            "/num_unconfirmed_txs": self._num_unconfirmed_txs,
+            "/consensus_state": self._consensus_state,
+            "/dump_consensus_state": self._dump_consensus_state,
+            "/broadcast_evidence": self._broadcast_evidence,
         }
         if self.debug:
             # profiling hooks (reference links net/http/pprof and starts a
@@ -426,6 +436,161 @@ class RPCServer:
     def _blockchain(self, q: dict) -> dict:
         store = self.node.block_store
         return {"base": store.base(), "height": store.height()}
+
+    # -- rpccore.Routes parity (reference node/node.go:898-986) --
+
+    def _commit(self, q: dict) -> dict:
+        """Block header + the commit that sealed it — the light-client /
+        commit-certificate flow (reference rpccore /commit). Defaults to
+        the latest committed height; serves the SEEN commit for the head
+        (the canonical commit lives in the NEXT block's LastCommit)."""
+        store = self.node.block_store
+        height = int(q.get("height", store.height()))
+        block = store.load_block(height)
+        if block is None:
+            raise ValueError(f"no block at height {height}")
+        commit = store.load_block_commit(height)
+        canonical = commit is not None
+        if commit is None:
+            commit = store.load_seen_commit(height)
+        if commit is None:
+            raise ValueError(f"no commit for height {height}")
+        h = block.header
+        return {
+            "header": {
+                "chain_id": h.chain_id,
+                "height": h.height,
+                "time_ns": h.time_ns,
+                "last_block_id": h.last_block_id.hex().upper(),
+                "app_hash": h.app_hash.hex(),
+                "validators_hash": h.validators_hash.hex(),
+                "evidence_hash": h.evidence_hash.hex(),
+                "proposer_address": h.proposer_address.hex().upper(),
+            },
+            "block_id": block.hash().hex().upper(),
+            "canonical": canonical,
+            "commit": {
+                "block_id": commit.block_id.hex().upper(),
+                "precommits": [
+                    {
+                        "height": v.height,
+                        "round": v.round,
+                        "block_id": v.block_id.hex().upper(),
+                        "timestamp_ns": v.timestamp_ns,
+                        "validator_address": v.validator_address.hex().upper(),
+                        "signature": (v.signature or b"").hex(),
+                    }
+                    for v in commit.precommits
+                ],
+            },
+        }
+
+    def _genesis(self, q: dict) -> dict:
+        import json as _json
+
+        return {"genesis": _json.loads(self.node.genesis.to_json())}
+
+    def _net_info(self, q: dict) -> dict:
+        peers = self.node.switch.peers()
+        return {
+            "listening": True,
+            "n_peers": len(peers),
+            "peers": [
+                {
+                    "node_id": p.node_id,
+                    "is_outbound": p.outbound,
+                }
+                for p in peers
+            ],
+        }
+
+    def _block_results(self, q: dict) -> dict:
+        """Per-tx ABCI results for a committed block (reference rpccore
+        /block_results, served from the persisted ABCIResponses)."""
+        height = int(q["height"])
+        raw = self.node.state_store.load_abci_responses(height)
+        if raw is None:
+            raise ValueError(f"no results for height {height}")
+        import json as _json
+
+        d = _json.loads(raw)
+        return {
+            "height": height,
+            "deliver_tx": d.get("deliver_tx", []),
+            "validator_updates": d.get("validator_updates", []),
+        }
+
+    def _unconfirmed_txs(self, q: dict) -> dict:
+        limit = min(int(q.get("limit", "30")), 100)
+        txs = self.node.mempool.reap_max_txs(limit)
+        return {
+            "n_txs": len(txs),
+            "total": self.node.mempool.size(),
+            "total_bytes": self.node.mempool.txs_bytes(),
+            "txs": [tx.hex() for tx in txs],
+        }
+
+    def _num_unconfirmed_txs(self, q: dict) -> dict:
+        return {
+            "total": self.node.mempool.size(),
+            "total_bytes": self.node.mempool.txs_bytes(),
+            "vote_pool": self.node.tx_vote_pool.size(),
+        }
+
+    def _round_state_obj(self, full: bool) -> dict:
+        cs = self.node.consensus
+        if cs is None:
+            raise ValueError("consensus is disabled on this node")
+        rs = cs.round_state()
+        out = {
+            "height": rs.height,
+            "round": rs.round,
+            "step": int(rs.step),
+            "start_time_ns": rs.start_time_ns,
+            "locked_round": rs.locked_round,
+            "valid_round": rs.valid_round,
+            "proposal": rs.proposal is not None,
+            "proposal_block": (
+                rs.proposal_block.hash().hex().upper()
+                if rs.proposal_block is not None
+                else ""
+            ),
+        }
+        if full:
+            _, _, votes = cs.current_round_data()
+            out["votes"] = [
+                {
+                    "height": v.height,
+                    "round": v.round,
+                    "type": v.type,
+                    "block_id": v.block_id.hex().upper(),
+                    "validator_address": v.validator_address.hex().upper(),
+                }
+                for v in votes
+            ]
+            out["validators"] = [
+                {"address": v.address.hex().upper(), "power": v.voting_power}
+                for v in (rs.validators or [])
+            ]
+        return out
+
+    def _consensus_state(self, q: dict) -> dict:
+        return {"round_state": self._round_state_obj(full=False)}
+
+    def _dump_consensus_state(self, q: dict) -> dict:
+        return {"round_state": self._round_state_obj(full=True)}
+
+    def _broadcast_evidence(self, q: dict) -> dict:
+        """Submit evidence (hex of the wire form); verified + gossiped via
+        the evidence pool (reference rpccore /broadcast_evidence)."""
+        from ..types.evidence import decode_evidence
+
+        raw = q["evidence"]
+        ev = decode_evidence(bytes.fromhex(raw[2:] if raw.startswith("0x") else raw))
+        added, err = self.node.evidence_pool.add(ev)
+        if err is not None:
+            raise ValueError(f"invalid evidence: {err}")
+        return {"hash": ev.hash().hex().upper(), "added": added}
 
     def _validators(self, q: dict) -> dict:
         vs = self.node.chain_state.validators

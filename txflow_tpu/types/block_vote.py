@@ -65,6 +65,14 @@ def canonical_block_vote_bytes(
     return amino.length_prefixed(bytes(body))
 
 
+_BV_SEMANTIC_FIELDS = frozenset(
+    (
+        "height", "round", "type", "block_id", "timestamp_ns",
+        "validator_address", "signature",
+    )
+)
+
+
 @dataclass
 class BlockVote:
     height: int
@@ -74,6 +82,17 @@ class BlockVote:
     timestamp_ns: int = field(default_factory=_time.time_ns)
     validator_address: bytes = b""
     signature: bytes | None = None
+    # wire cache, lazily filled once the vote is signed (immutable from
+    # then on); consensus gossip re-offers the same votes every tick per
+    # peer, which re-serialized each one (r4 config-5 profile: 93k
+    # encodes for ~10k votes). __setattr__ clears it on any semantic
+    # write, so tampering can never serve stale bytes.
+    _wire_cache: bytes | None = field(default=None, repr=False, compare=False)
+
+    def __setattr__(self, name, value):
+        if name in _BV_SEMANTIC_FIELDS:
+            object.__setattr__(self, "_wire_cache", None)
+        object.__setattr__(self, name, value)
 
     @property
     def is_nil(self) -> bool:
@@ -102,6 +121,8 @@ class BlockVote:
 
 
 def encode_block_vote(v: BlockVote) -> bytes:
+    if v._wire_cache is not None:
+        return v._wire_cache
     body = bytearray()
     body += amino.field_key(1, amino.TYP3_VARINT)
     body += amino.varint(v.height)
@@ -122,7 +143,10 @@ def encode_block_vote(v: BlockVote) -> bytes:
     if v.signature:
         body += amino.field_key(7, amino.TYP3_BYTELEN)
         body += amino.length_prefixed(v.signature)
-    return bytes(body)
+    out = bytes(body)
+    if v.signature is not None:  # immutable once signed
+        object.__setattr__(v, "_wire_cache", out)
+    return out
 
 
 def decode_block_vote(data: bytes) -> BlockVote:

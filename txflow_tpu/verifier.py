@@ -132,12 +132,20 @@ def first_occurrence_mask(tx_slot, val_idx) -> np.ndarray:
     the first occurrence, in batch (arrival) order; callers re-offer dropped
     votes in a later batch if the validator still hasn't been tallied.
     """
-    pairs = np.stack(
-        [np.asarray(tx_slot, dtype=np.int64), np.asarray(val_idx, dtype=np.int64)],
-        axis=1,
-    )
-    _, first = np.unique(pairs, axis=0, return_index=True)
-    mask = np.zeros(len(pairs), dtype=bool)
+    slot = np.asarray(tx_slot, dtype=np.int64)
+    val = np.asarray(val_idx, dtype=np.int64)
+    if len(slot) == 0:
+        return np.zeros(0, dtype=bool)
+    # 1-D combined key instead of np.unique(axis=0) (structured-sort path
+    # measured ~5x slower at batch scale, r4 profile): shift both axes
+    # non-negative, multiply past the validator range — distinct pairs <->
+    # distinct keys
+    vmin, vmax = int(val.min()), int(val.max())
+    smin = int(slot.min())
+    m = vmax - vmin + 2
+    combined = (slot - smin) * m + (val - vmin)
+    _, first = np.unique(combined, return_index=True)
+    mask = np.zeros(len(combined), dtype=bool)
     mask[first] = True
     return mask
 
