@@ -517,22 +517,16 @@ def main():
     ):
         # CPU number ONLY because the TPU was unreachable right now (probe
         # failure / mid-run tunnel loss — never an explicit BENCH_PLATFORM
-        # choice): report the freshest banked TPU measurement as the
-        # headline, with the live CPU run attached for transparency.
+        # choice). The live CPU run stays the headline — a consumer reading
+        # only the top-level metric must see THIS run's measurement (r4
+        # advisor) — and the freshest banked TPU measurement rides along
+        # under its own key for context.
         banked = _load_banked_tpu()
         if banked is not None:
-            banked["reused_banked_tpu_measurement"] = True
             banked["banked_age_s"] = round(
                 time.time() - banked.get("measured_at_unix", 0), 1
             )
-            banked["cpu_fallback_run_now"] = {
-                k: result.get(k)
-                for k in ("value", "p50_commit_latency_ms", "platform", "error")
-                if k in result
-            }
-            if _PROBE_DIAGNOSTICS:
-                banked["probe_diagnostics"] = _PROBE_DIAGNOSTICS
-            result = banked
+            result["last_known_tpu"] = banked
     print(json.dumps(result))
 
 

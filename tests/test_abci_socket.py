@@ -194,3 +194,67 @@ def test_node_commits_through_subprocess_app():
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_exception_mid_drain_keeps_stream_aligned():
+    """An app EXCEPTION for one pipelined request must not desync the
+    connection: later pipelined responses still resolve, the fence's own
+    frame is consumed, and the NEXT call reads its own response — not a
+    stale frame (r4 advisor: _drain_pending previously abandoned the
+    remaining responses in the socket)."""
+
+    class Exploding(KVStoreApplication):
+        def deliver_tx(self, tx: bytes) -> ResponseDeliverTx:
+            if tx.startswith(b"boom"):
+                raise RuntimeError("mid-pipeline kaboom")
+            return super().deliver_tx(tx)
+
+    srv = ABCIServer(Exploding())
+    srv.start()
+    try:
+        conns = RemoteAppConns(f"{srv.addr[0]}:{srv.addr[1]}")
+        c = conns.consensus
+        rs = [
+            c.deliver_tx_async(b"a=1"),
+            c.deliver_tx_async(b"boom"),
+            c.deliver_tx_async(b"b=2"),
+            c.deliver_tx_async(b"c=3"),
+        ]
+        with pytest.raises(RuntimeError, match="mid-pipeline kaboom"):
+            c.flush()
+        # entries after the failed one were still drained and resolved
+        assert rs[0].value.code == 0
+        assert rs[2].value.code == 0
+        assert rs[3].value.code == 0
+        # the failed entry re-raises its recorded error on read
+        with pytest.raises(RuntimeError, match="mid-pipeline kaboom"):
+            _ = rs[1].value
+        # and the connection is ALIGNED: a fresh sync call gets its own
+        # response, not the leftover of an unread frame
+        assert c.deliver_tx_async(b"d=4").value.code == 0
+        commit = c.commit_sync()
+        assert commit.data
+        conns.close()
+    finally:
+        srv.stop()
+
+
+def test_async_callback_fires_at_fence_without_forcing_flush():
+    """Registering a callback must not itself force a flush round-trip;
+    callbacks fire in submit order when a fence resolves the entries
+    (reference ReqRes callback-at-flush semantics)."""
+    srv = ABCIServer(KVStoreApplication())
+    srv.start()
+    try:
+        conns = RemoteAppConns(f"{srv.addr[0]}:{srv.addr[1]}")
+        seen = []
+        for i in range(5):
+            conns.mempool.check_tx_async(
+                b"cb%d=v" % i, callback=lambda r, i=i: seen.append((i, r.code))
+            )
+        assert seen == []  # nothing fired yet: no fence has run
+        conns.mempool.flush()
+        assert seen == [(i, 0) for i in range(5)]
+        conns.close()
+    finally:
+        srv.stop()

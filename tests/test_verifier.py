@@ -426,3 +426,37 @@ def test_device_verifier_cached_parity(device_verifier_factory=None):
     want3 = golden.verify_and_tally(msgs, sigs, vidx, slot, n_slots, prior_stake=prior)
     np.testing.assert_array_equal(want3.stake, got3.stake)
     np.testing.assert_array_equal(want3.maj23, got3.maj23)
+
+
+def test_verify_cache_binds_pubkey_not_index():
+    """A shared cache outliving a validator-set change must never replay a
+    'valid' verdict for a signature that was checked against a DIFFERENT
+    key now living at the same index (r4 advisor: keys previously bound
+    the index). Two sets are built so a seed-A validator sits at index 0
+    in set A and a different key sits at index 0 in set B."""
+    from txflow_tpu.verifier import VerifyCache
+
+    seed_a = hashlib.sha256(b"epoch-a-val").digest()
+    seed_b = hashlib.sha256(b"epoch-b-val").digest()
+    pub_a = host_ed.public_key_from_seed(seed_a)
+    pub_b = host_ed.public_key_from_seed(seed_b)
+    set_a = ValidatorSet([Validator.from_pub_key(pub_a, 10)])
+    set_b = ValidatorSet([Validator.from_pub_key(pub_b, 10)])
+
+    msg = canonical_sign_bytes(CHAIN_ID, 1, "AA" * 32, 1700000000_000000000)
+    sig = host_ed.sign(seed_a, msg)  # valid under pub_a only
+
+    cache = VerifyCache()
+    v_a = ScalarVoteVerifier(set_a, shared_cache=cache)
+    v_b = ScalarVoteVerifier(set_b, shared_cache=cache)
+
+    r_a = v_a.verify_and_tally([msg], [sig], np.array([0]), np.array([0]), 1)
+    assert r_a.valid[0]  # genuinely valid under set A, now cached
+    r_b = v_b.verify_and_tally([msg], [sig], np.array([0]), np.array([0]), 1)
+    assert not r_b.valid[0]  # same index, different key: MUST miss + fail
+
+    # and the key is split-unambiguous: shifting a boundary byte between
+    # msg and sig yields a different cache key
+    k1 = VerifyCache.key(msg, sig, pub_a)
+    k2 = VerifyCache.key(msg + sig[:1], sig[1:], pub_a)
+    assert k1 != k2

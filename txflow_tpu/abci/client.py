@@ -26,6 +26,7 @@ class _Pending:
     kind: int
     result: object = None  # mirrors proxy._Result.value
     resolved: bool = False
+    callback: object = None  # fired at the fence that resolves this entry
 
 
 class _SocketConn:
@@ -77,15 +78,41 @@ class _SocketConn:
 
     def _call_sync(self, payload: bytes, kind: int):
         """Write + drain pending + read this call's response (a sync call
-        is itself a fence for previously pipelined async requests)."""
-        with self._mtx:
-            try:
-                self._send(payload, flush=True)
-                self._drain_pending()
-                return self._read_response(kind)
-            except Exception as e:
-                self._error = e
-                raise
+        is itself a fence for previously pipelined async requests).
+
+        If the drain surfaced an app-level error the stream is still
+        aligned, so this call's own response frame must be consumed before
+        re-raising — otherwise the next caller reads it as a stale frame.
+
+        Callbacks registered on resolved entries fire AFTER the lock is
+        released and the stream is fully aligned — a raising or re-entrant
+        callback can then no longer desync the connection. As in the
+        reference (ReqRes), a callback never fires for an entry that
+        resolved to an error; the error propagates via the fence and
+        ``.value`` instead.
+        """
+        cbs: list = []
+        try:
+            with self._mtx:
+                try:
+                    self._send(payload, flush=True)
+                    drain_err = None
+                    try:
+                        self._drain_pending(cbs)
+                    except (ConnectionError, OSError):
+                        raise
+                    except Exception as e:
+                        drain_err = e
+                    res = self._read_response(kind)
+                    if drain_err is not None:
+                        raise drain_err
+                    return res
+                except Exception as e:
+                    self._error = e
+                    raise
+        finally:
+            for cb, r in cbs:
+                cb(r)
 
     def _call_async(self, payload: bytes, kind: int) -> _Pending:
         p = _Pending(kind)
@@ -98,22 +125,51 @@ class _SocketConn:
                 raise
         return p
 
-    def _drain_pending(self) -> None:
+    def _drain_pending(self, cbs: list) -> None:
+        """Resolve every pipelined placeholder, in order.
+
+        An app-level EXCEPTION response consumes exactly one frame, so the
+        stream stays aligned: keep draining the remaining responses and
+        raise the first error only after every pending entry is resolved
+        (otherwise later entries would never resolve and the next call
+        would read a stale frame — silent desync, r4 advisor). A transport
+        error (socket dead) is different: nothing more is readable, so the
+        remaining entries are failed immediately without blocking reads.
+
+        Successful entries' callbacks are APPENDED to ``cbs`` for the
+        caller to fire after the lock drops — invoking user code mid-drain
+        (under the lock) would let a raising/re-entrant callback abort the
+        drain and desync the stream.
+        """
         pending, self._pending = self._pending, []
+        first_err: Exception | None = None
+        dead: Exception | None = None
         for p in pending:
-            p.result = self._read_response(p.kind)
+            if dead is not None:
+                p.result = dead
+                p.resolved = True
+                continue
+            try:
+                p.result = self._read_response(p.kind)
+            except (ConnectionError, OSError) as e:
+                dead = e
+                p.result = e
+                if first_err is None:
+                    first_err = e
+            except Exception as e:
+                p.result = e
+                if first_err is None:
+                    first_err = e
             p.resolved = True
+            if p.callback is not None and not isinstance(p.result, Exception):
+                cbs.append((p.callback, p.result))
+        if first_err is not None:
+            raise first_err
 
     def flush(self) -> None:
-        """The pipeline fence: resolves every async placeholder."""
-        with self._mtx:
-            try:
-                self._send(wire.encode_request(wire.FLUSH), flush=True)
-                self._drain_pending()
-                self._read_response(wire.FLUSH)
-            except Exception as e:
-                self._error = e
-                raise
+        """The pipeline fence: resolves every async placeholder (a Flush
+        request is just a sync call whose response carries no payload)."""
+        self._call_sync(wire.encode_request(wire.FLUSH), wire.FLUSH)
 
     def echo(self, msg: bytes) -> bytes:
         return self._call_sync(wire.encode_request(wire.ECHO, raw=msg), wire.ECHO)
@@ -140,6 +196,8 @@ class _AsyncResult:
     def value(self):
         if not self._p.resolved:
             self._conn.flush()
+        if isinstance(self._p.result, Exception):
+            raise self._p.result
         return self._p.result
 
 
@@ -151,10 +209,13 @@ class AppConnMempool(_SocketConn):
 
     def check_tx_async(self, tx: bytes, callback=None) -> _AsyncResult:
         p = self._call_async(wire.encode_request(wire.CHECK_TX, raw=tx), wire.CHECK_TX)
-        if callback is not None:
-            # callbacks fire at the flush fence, in submit order
-            self.flush()
-            callback(p.result)
+        # Shared AppConns contract: a callback fires once its response is
+        # AVAILABLE — immediately for the in-process proxy (inline
+        # resolution), at the next fence here (registering one must not
+        # itself force a flush round-trip, r4 advisor); it never fires for
+        # an errored call (reference ReqRes: the client error is set and
+        # the error reaches the fence caller / .value reader instead).
+        p.callback = callback
         return _AsyncResult(p, self)
 
 
@@ -174,9 +235,7 @@ class AppConnConsensus(_SocketConn):
         p = self._call_async(
             wire.encode_request(wire.DELIVER_TX, raw=tx), wire.DELIVER_TX
         )
-        if callback is not None:
-            self.flush()
-            callback(p.result)
+        p.callback = callback
         return _AsyncResult(p, self)
 
     def end_block_sync(self, req):

@@ -58,6 +58,9 @@ class AppConnMempool(_Conn):
             return self._app.check_tx(tx)
 
     def check_tx_async(self, tx: bytes, callback=None) -> _Result:
+        # Shared AppConns contract (see abci/client.py): the callback
+        # fires once the response is available — which, in-process, is
+        # right now; over the socket it is the next fence.
         res = _Result()
         with self._lock:
             res.value = self._app.check_tx(tx)

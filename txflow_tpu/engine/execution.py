@@ -81,10 +81,11 @@ class TxExecutor:
         on Commit cadence (none of the bundled ones) must keep it at 1.
         Returns (app_hash, deliver_results)."""
         t0 = time.perf_counter()
-        results = []
-        for tx, _ in items:
-            res = self.proxy_app.deliver_tx_async(tx)
-            results.append(res.value)
+        # pipeline all DeliverTxs, fence once (.value per call would force
+        # a flush round-trip each over RemoteAppConns, r4 advisor)
+        pending = [self.proxy_app.deliver_tx_async(tx) for tx, _ in items]
+        self.proxy_app.flush()
+        results = [p.value for p in pending]
         self.metrics.tx_processing_time.observe(time.perf_counter() - t0)
 
         failpoints.fail("txflow-before-commit")

@@ -300,7 +300,11 @@ class BlockExecutor:
             for tx in block.vtxs:
                 if vtx_filter(tx):
                     self.proxy_app.deliver_tx_async(tx)
-        deliver = []
+        # pipeline the whole block, then fence once: over RemoteAppConns a
+        # .value read forces a flush round-trip, so reading per tx would
+        # serialize execution (reference shape: DeliverTxAsync × N then one
+        # Flush, state/execution.go:246-310)
+        slots: list = []
         for tx in block.txs:
             if vtx_filter is not None and not vtx_filter(tx):
                 # the local fast path already applied this tx (it slipped
@@ -311,10 +315,13 @@ class BlockExecutor:
                 # requires fast-path-eligible DeliverTx responses to be
                 # (code OK, empty data) — per-tx results flow through the
                 # fast path's own commit events instead.
-                deliver.append(ResponseDeliverTx())
+                slots.append(ResponseDeliverTx())
                 continue
-            deliver.append(self.proxy_app.deliver_tx_async(tx).value)
+            slots.append(self.proxy_app.deliver_tx_async(tx))
         self.proxy_app.flush()
+        deliver = [
+            s if isinstance(s, ResponseDeliverTx) else s.value for s in slots
+        ]
         end = self.proxy_app.end_block_sync(RequestEndBlock(height=block.height))
         return ABCIResponses(deliver_tx=deliver, end_block=end)
 

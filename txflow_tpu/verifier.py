@@ -61,12 +61,16 @@ class VerifyCache:
     the 4-node bench ran 4x the kernel work of the 1-node case). The first
     engine to see a vote pays the device verify; the rest hit this cache.
 
-    Keys bind ALL inputs — sha256(sign_bytes ‖ signature ‖ validator
-    index) — so a byzantine validator re-using one signature across
-    different payloads (or two validators sharing key material) can never
-    alias a cached verdict. The reference has no analog: its validators
-    are one-process-per-node, so the question never arises
-    (txflow/service.go:123-166 verifies serially per node).
+    Keys bind ALL inputs — sha256(len(msg) ‖ msg ‖ len(sig) ‖ sig ‖
+    pubkey) — so a byzantine validator re-using one signature across
+    different payloads can never alias a cached verdict, and (r4 advisor)
+    the key survives validator-set changes: it binds the *resolved public
+    key*, not the validator index, so a cache outliving an END_BLOCK
+    validator update can never replay a verdict against a different key
+    that now occupies the same index. Fields are length-prefixed so no
+    (msg, sig) split ambiguity exists either. The reference has no
+    analog: its validators are one-process-per-node, so the question
+    never arises (txflow/service.go:123-166 verifies serially per node).
     """
 
     def __init__(self, capacity: int = 1 << 17):
@@ -80,10 +84,16 @@ class VerifyCache:
         self.misses = 0
 
     @staticmethod
-    def key(msg: bytes, sig: bytes, val_idx: int) -> bytes:
+    def key(msg: bytes, sig: bytes, pub_key: bytes) -> bytes:
         from .crypto.hash import sha256
 
-        return sha256(msg + sig + val_idx.to_bytes(4, "little", signed=True))
+        return sha256(
+            len(msg).to_bytes(4, "little")
+            + msg
+            + len(sig).to_bytes(4, "little")
+            + sig
+            + pub_key
+        )
 
     def lookup_many(self, keys: list[bytes | None]) -> list[bool | None]:
         """One lock hold for the whole batch; None = miss (or None key)."""
@@ -179,7 +189,7 @@ class ScalarVoteVerifier:
         valid = np.zeros(n, dtype=bool)
         if self.cache is not None:
             keys = [
-                VerifyCache.key(msgs[i], sigs[i], int(val_idx[i]))
+                VerifyCache.key(msgs[i], sigs[i], self._pub_keys[int(val_idx[i])])
                 if keep[i] and 0 <= val_idx[i] < len(self._pub_keys)
                 else None
                 for i in range(n)
@@ -240,7 +250,8 @@ class DeviceVoteVerifier:
             self.cache: VerifyCache | None = VerifyCache()
         else:
             self.cache = shared_cache or None
-        self.epoch = ed25519_batch.EpochTables([v.pub_key for v in val_set])
+        self._pub_keys = [v.pub_key for v in val_set]
+        self.epoch = ed25519_batch.EpochTables(self._pub_keys)
         self._powers = val_set.powers_array().astype(np.int32)
         # int32 device tally: with dedup, per-slot batch stake and prior
         # stake are each <= total power, so their sum stays < 2^31 only if
@@ -369,7 +380,7 @@ class DeviceVoteVerifier:
         n = len(msgs)
         n_vals = len(self._powers)
         keys: list[bytes | None] = [
-            VerifyCache.key(msgs[i], sigs[i], int(val_idx[i]))
+            VerifyCache.key(msgs[i], sigs[i], self._pub_keys[int(val_idx[i])])
             if keep[i] and 0 <= val_idx[i] < n_vals
             else None
             for i in range(n)
