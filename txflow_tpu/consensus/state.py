@@ -161,6 +161,27 @@ class ConsensusState:
         with self._mtx:
             return self.rs
 
+    def round_summary(self) -> dict:
+        """Position + vote-knowledge announce payload: height/round/step/
+        committed plus current-round prevote/precommit bitmasks and a
+        has-proposal flag. Receivers keep these in PeerRoundState so the
+        re-offer path sends only what a peer lacks (the reference ships
+        the same facts as NewRoundStep + per-vote HasVote messages,
+        consensus/reactor.go:904-1340)."""
+        with self._mtx:
+            rs = self.rs
+            d = {
+                "height": rs.height,
+                "round": rs.round,
+                "step": int(rs.step),
+                "committed": self.state.last_block_height,
+                "has_proposal": rs.proposal is not None,
+            }
+            if rs.votes is not None:
+                d["prevotes"] = "%x" % rs.votes.prevotes(rs.round).bitmask()
+                d["precommits"] = "%x" % rs.votes.precommits(rs.round).bitmask()
+            return d
+
     def current_round_data(self):
         """Snapshot for retransmission gossip: (proposal, block, votes).
         Push-once gossip loses messages sent before peers connect; the
@@ -449,6 +470,26 @@ class ConsensusState:
         # internal message: same serialized path as peer proposals (:912-921)
         self.add_proposal(proposal, block)
         self.broadcast_proposal(proposal, block)
+
+    def verify_proposal_signature(self, proposal: Proposal) -> bool:
+        """True iff the proposal is for the CURRENT (height, round) and
+        carries the current proposer's valid signature — the gate for
+        accepting a chunked-proposal parts header before any block bytes
+        are buffered (the reference's parts ride under an already-
+        verified Proposal the same way, consensus/state.go:688-692)."""
+        with self._mtx:
+            rs = self.rs
+            if proposal.height != rs.height or proposal.round != rs.round:
+                return False
+            proposer = rs.validators.get_proposer()
+            chain_id = self.state.chain_id
+        from ..crypto import ed25519
+
+        return bool(proposal.signature) and ed25519.verify(
+            proposer.pub_key,
+            proposal.sign_bytes(chain_id),
+            proposal.signature,
+        )
 
     def _set_proposal(self, proposal: Proposal, block: Block | None) -> None:
         rs = self.rs

@@ -59,6 +59,35 @@ class Proposal:
 
 
 @dataclass
+class PeerRoundState:
+    """What a peer has told us about its round position and vote
+    knowledge (reference consensus/types/peer_round_state.go +
+    the PeerState bitarrays of consensus/reactor.go:904-1340).
+
+    ``vote_masks`` maps (round, vote_type) -> validator-index bitmask of
+    votes the peer is known to hold — from its periodic announces (exact),
+    from votes it sent us (it has what it sends), and from votes we sent
+    it over the reliable consensus lane (it will have them). The re-offer
+    path sends a peer only the deltas, replacing the full round-data dump
+    per gossip tick."""
+
+    height: int = 0
+    round: int = -1
+    step: int = -1
+    committed: int = 0
+    has_proposal: bool = False
+    vote_masks: dict = field(default_factory=dict)
+
+    def mark_vote(self, round_: int, vote_type: int, val_idx: int) -> None:
+        if val_idx >= 0:
+            key = (round_, vote_type)
+            self.vote_masks[key] = self.vote_masks.get(key, 0) | (1 << val_idx)
+
+    def has_vote(self, round_: int, vote_type: int, val_idx: int) -> bool:
+        return bool(self.vote_masks.get((round_, vote_type), 0) >> val_idx & 1)
+
+
+@dataclass
 class RoundState:
     height: int = 1
     round: int = 0

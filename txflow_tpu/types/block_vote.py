@@ -284,6 +284,18 @@ class BlockVoteSet:
     def vote_list(self) -> list[BlockVote]:
         return list(self.votes.values())
 
+    def bitmask(self) -> int:
+        """Validator-index bitmask of received votes — the gossip
+        announce's compact 'what I have' summary (the reference exchanges
+        the same information as per-peer BitArrays via NewRoundStep/
+        HasVote, consensus/reactor.go:904-1340)."""
+        mask = 0
+        for addr in self.votes:
+            idx, _ = self.val_set.get_by_address(addr)
+            if idx >= 0:
+                mask |= 1 << idx
+        return mask
+
     def size(self) -> int:
         return len(self.votes)
 
