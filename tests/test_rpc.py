@@ -398,3 +398,78 @@ def test_rpc_route_parity():
             assert e.code == 500
     finally:
         net.stop()
+
+
+def test_rpc_hardening_body_cap_and_connection_cap():
+    """Oversized POST bodies get 413 + connection close; connections past
+    MAX_OPEN_CONNECTIONS are refused instead of spawning threads
+    (reference MaxOpenConnections / request limits, node/node.go:925-929)."""
+    import socket
+
+    import txflow_tpu.rpc.server as rpcmod
+
+    net = LocalNet(1, use_device_verifier=False, rpc=True)
+    net.start()
+    try:
+        host, port = net.nodes[0].rpc.addr
+
+        # -- oversized body: 413, connection closed, server still alive --
+        s = socket.create_connection((host, port), timeout=10)
+        s.sendall(
+            b"POST /status HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: %d\r\n\r\n" % (rpcmod.MAX_BODY_BYTES + 1)
+        )
+        s.sendall(b"x" * 1024)  # partial body; server must not wait for it
+        resp = s.recv(4096)
+        assert b"413" in resp.split(b"\r\n", 1)[0], resp[:100]
+        s.close()
+        # server still serves normal requests afterwards
+        assert rpc_get((host, port), "/health")["result"] == {}
+
+        # -- connection flood: at most MAX_OPEN_CONNECTIONS serviced --
+        old_cap = rpcmod.MAX_OPEN_CONNECTIONS
+        sem = net.nodes[0].rpc._httpd._conn_sem
+        # shrink the live semaphore to a tiny cap for the test
+        drained = 0
+        while sem.acquire(blocking=False):
+            drained += 1
+        for _ in range(2):  # leave capacity 2
+            sem.release()
+        try:
+            conns = []
+            served, refused = 0, 0
+            for _ in range(6):
+                c = socket.create_connection((host, port), timeout=5)
+                try:
+                    c.sendall(b"GET /health HTTP/1.1\r\nHost: x\r\n\r\n")
+                except OSError:
+                    # server already RST the over-cap connection before
+                    # our send landed: that IS a refusal
+                    refused += 1
+                    c.close()
+                    continue
+                conns.append(c)
+                time.sleep(0.05)
+            for c in conns:
+                c.settimeout(2)
+                try:
+                    data = c.recv(2048)
+                except (TimeoutError, OSError):
+                    data = b""
+                if b"200" in data.split(b"\r\n", 1)[0] if data else False:
+                    served += 1
+                else:
+                    refused += 1
+            assert served <= 2, f"cap not enforced: {served} served"
+            assert refused >= 4, f"expected refusals, got {refused}"
+            for c in conns:
+                c.close()
+        finally:
+            # restore the semaphore's capacity
+            for _ in range(drained - 2):
+                sem.release()
+        # normal service restored
+        time.sleep(0.1)
+        assert rpc_get((host, port), "/health")["result"] == {}
+    finally:
+        net.stop()

@@ -165,19 +165,27 @@ class TxFlow:
         if min_batch <= 1:
             return
         deadline = time.monotonic() + self.config.batch_wait
+        idle_flush = self.config.idle_flush
         while True:
             # unvisited ingest ≈ seq (log end) minus the drain cursor:
             # both advance monotonically, so this over-counts only by the
             # removed-not-yet-visited entries — a safe coalescing estimate
-            pending = (
-                self.tx_vote_pool.seq() - self._drain_cursor + len(self._retry)
-            )
+            seq_now = self.tx_vote_pool.seq()
+            pending = seq_now - self._drain_cursor + len(self._retry)
             remaining = deadline - time.monotonic()
             if pending >= min_batch or remaining <= 0:
                 return
-            self.tx_vote_pool.wait_for_new(
-                self.tx_vote_pool.seq(), timeout=remaining
-            )
+            # adaptive wait: at light load arrivals come in per-tx bursts
+            # and then stall — once votes are pending and nothing new
+            # arrives within idle_flush, process NOW (p50 stops paying
+            # batch_wait); under sustained load new votes keep landing
+            # inside the window, so coalescing to min_batch is unchanged
+            timeout = remaining
+            if idle_flush > 0 and pending > 0:
+                timeout = min(remaining, idle_flush)
+            got = self.tx_vote_pool.wait_for_new(seq_now, timeout=timeout)
+            if got == seq_now and pending > 0:
+                return
 
     # ---- batched aggregation step ----
 

@@ -60,6 +60,40 @@ def _event_json(ev) -> dict:
     return {"type": ev.type}
 
 
+# request-body and concurrency caps (reference MaxOpenConnections /
+# request limits, node/node.go:925-929)
+MAX_BODY_BYTES = 1 << 20
+MAX_OPEN_CONNECTIONS = 128
+
+
+class _BoundedHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a hard cap on concurrent connections:
+    past MAX_OPEN_CONNECTIONS the listener closes new sockets immediately
+    instead of spawning an unbounded thread per connection (a connection
+    flood would otherwise exhaust threads/filedescriptors)."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, handler):
+        self._conn_sem = threading.Semaphore(MAX_OPEN_CONNECTIONS)
+        super().__init__(addr, handler)
+
+    def process_request(self, request, client_address):
+        if not self._conn_sem.acquire(blocking=False):
+            try:
+                request.close()
+            except OSError:
+                pass
+            return
+        super().process_request(request, client_address)
+
+    def process_request_thread(self, request, client_address):
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            self._conn_sem.release()
+
+
 class RPCServer:
     def __init__(self, node, host: str = "127.0.0.1", port: int = 0, debug=None):
         """debug: expose /debug/* hooks. Default: only on loopback binds —
@@ -72,6 +106,13 @@ class RPCServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Read timeout: without it, an idle client parks its handler
+            # thread in readline() forever, and MAX_OPEN_CONNECTIONS
+            # permits are never released — 128 silent sockets would
+            # hard-lock the whole RPC (r5 review). The reference pairs
+            # MaxOpenConnections with read timeouts the same way. The
+            # websocket path lifts it after the upgrade (long-lived).
+            timeout = 30
 
             def log_message(self, fmt, *args):  # quiet by default
                 pass
@@ -93,14 +134,57 @@ class RPCServer:
                 self.wfile.write(payload)
 
             def do_POST(self):
+                # Body size cap (reference caps request sizes via its RPC
+                # server config, node/node.go:925-929): an oversized body
+                # is rejected with 413 and the connection dropped — partly
+                # reading it and dispatching anyway would desync keep-
+                # alive framing, and reading it all would buffer
+                # attacker-sized payloads.
+                try:
+                    n = int(self.headers.get("Content-Length", "0") or "0")
+                except ValueError:
+                    n = 0
+                if n > MAX_BODY_BYTES:
+                    # tell the client explicitly (Connection: close) and
+                    # drain a bounded slice of the in-flight body before
+                    # closing — an immediate close with unread bytes in
+                    # the receive buffer emits RST and destroys the 413
+                    # before the client reads it (r5 review)
+                    self.close_connection = True
+                    payload = json.dumps(
+                        {"error": "request body too large"}
+                    ).encode()
+                    self.send_response(413)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    self.wfile.flush()
+                    try:
+                        self.connection.settimeout(2)
+                        remaining = min(n, 4 * MAX_BODY_BYTES)
+                        while remaining > 0:
+                            got = self.rfile.read(min(remaining, 65536))
+                            if not got:
+                                break
+                            remaining -= len(got)
+                    except OSError:
+                        pass
+                    return
+                if self.headers.get("Transfer-Encoding"):
+                    # chunked bodies are not parsed: dispatch, then drop
+                    # the connection so unread chunk bytes can never be
+                    # misread as the next request line (and the size cap
+                    # cannot be bypassed by omitting Content-Length)
+                    self.close_connection = True
                 # drain the body BEFORE dispatch: with keep-alive enabled,
                 # unread body bytes would be parsed as the next request
                 # line on this connection
                 try:
-                    n = int(self.headers.get("Content-Length", "0") or "0")
                     if n > 0:
-                        self.rfile.read(min(n, 1 << 20))
-                except (ValueError, OSError):
+                        self.rfile.read(n)
+                except OSError:
                     pass
                 self.do_GET()
 
@@ -130,7 +214,7 @@ class RPCServer:
                 except Exception as e:
                     self._reply({"error": repr(e)}, 500)
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = _BoundedHTTPServer((host, port), Handler)
         self.addr = self._httpd.server_address
         self._thread: threading.Thread | None = None
         self._routes = {
@@ -252,6 +336,13 @@ class RPCServer:
             handler.send_response(400)
             handler.end_headers()
             return
+        # long-lived stream: lift the HTTP read timeout set on the
+        # handler class (idle subscribers are legitimate here; the pump
+        # has its own liveness handling)
+        try:
+            handler.connection.settimeout(None)
+        except OSError:
+            pass
         accept = base64.b64encode(
             _hl.sha1((key + self._WS_GUID).encode()).digest()
         ).decode()
