@@ -40,6 +40,12 @@ _CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cach
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
 
 _PROBE_DIAGNOSTICS: dict = {}
+if os.environ.get("BENCH_PROBE_DIAG"):
+    # carried across the sanitized CPU re-exec (see _force_cpu)
+    try:
+        _PROBE_DIAGNOSTICS.update(json.loads(os.environ["BENCH_PROBE_DIAG"]))
+    except ValueError:
+        pass
 
 
 def _resolve_platform() -> str:
@@ -102,13 +108,48 @@ def _resolve_platform() -> str:
     return "cpu"
 
 
+def _sanitized_cpu_env() -> dict:
+    """Env for a CPU re-exec with the axon site hook REMOVED.
+
+    r5 observed failure mode: with the tunnel wedged in accept-and-stall,
+    the PYTHONPATH site hook (.axon_site sitecustomize) hangs `import
+    jax` ITSELF — even under JAX_PLATFORMS=cpu — so no amount of
+    in-process pinning can save a fallback once jax is imported. The only
+    robust fallback is a re-exec without the hook on PYTHONPATH."""
+    env = dict(os.environ, BENCH_PLATFORM="cpu", JAX_PLATFORMS="cpu")
+    parts = [
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p
+    ]
+    if parts:
+        env["PYTHONPATH"] = os.pathsep.join(parts)
+    else:
+        env.pop("PYTHONPATH", None)
+    env["BENCH_CPU_SANITIZED"] = "1"
+    return env
+
+
 def _force_cpu() -> None:
     """Pin this process to the CPU backend.
 
     The environment's PJRT site hook can pre-register the TPU platform and
     ignore the JAX_PLATFORMS env var, so the pin must also go through
     jax.config after import — BEFORE any backend is created (a TPU client
-    init here can hang for minutes)."""
+    init here can hang for minutes). When the site hook is present and the
+    probe says the tunnel is wedged, even importing jax can hang (r5) —
+    re-exec with a sanitized env instead of pinning in-process."""
+    if (
+        os.environ.get("BENCH_CPU_SANITIZED") != "1"
+        and ".axon_site" in os.environ.get("PYTHONPATH", "")
+        and "jax" not in sys.modules
+    ):
+        env = _sanitized_cpu_env()
+        if _PROBE_DIAGNOSTICS:
+            blob = json.dumps(_PROBE_DIAGNOSTICS)
+            if len(blob) <= 30000:  # never ship truncated (= invalid) JSON
+                env["BENCH_PROBE_DIAG"] = blob
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
@@ -513,10 +554,8 @@ def main():
             # TPU path failed mid-run: re-exec once on CPU so the driver
             # still records a real number (flagged by "platform": "cpu").
             print(f"bench: {platform} run failed ({e}); retrying on CPU", file=sys.stderr)
-            env = dict(
-                os.environ, BENCH_PLATFORM="cpu", JAX_PLATFORMS="cpu",
-                BENCH_TPU_FELL_BACK="1",
-            )
+            env = _sanitized_cpu_env()
+            env["BENCH_TPU_FELL_BACK"] = "1"
             os.execve(sys.executable, [sys.executable] + sys.argv, env)
         result = {
             "metric": "committed_txvotes_per_sec",

@@ -121,14 +121,26 @@ def table_select_indexed(tables_flat, idx):
     # path on the tunneled TPU (an 8-vote entry() program compiled for
     # >25 minutes, r3) — small or huge-table cases take the plain gather
     if E <= 2048 and batch >= 256:
+        # dtype must represent every table limb EXACTLY: radix-8 limbs
+        # (< 256) fit bfloat16's 8 significand bits; radix-13 limbs
+        # (< 8192) need float32 (24 bits). One-hot entries are 0/1 and the
+        # accumulator is f32 either way, so the select stays bit-exact.
+        sel_dtype = jnp.bfloat16 if fe.RADIX == 8 else jnp.float32
         onehot = (
             idx[..., None] == jnp.arange(E, dtype=jnp.int32)
-        ).astype(jnp.bfloat16)
+        ).astype(sel_dtype)
         sel = jax.lax.dot_general(
             onehot,
-            tables_flat.astype(jnp.bfloat16),
+            tables_flat.astype(sel_dtype),
             (((onehot.ndim - 1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            # f32 path (radix-13): default MXU precision truncates f32
+            # operands to bf16, which loses the low ~5 bits of 13-bit
+            # limbs — HIGHEST keeps the pass bit-exact (r5 review); the
+            # bf16 path is exact by construction (limbs < 256)
+            precision=(
+                None if sel_dtype == jnp.bfloat16 else jax.lax.Precision.HIGHEST
+            ),
         ).astype(jnp.int32)
     else:
         sel = jnp.take(tables_flat, idx, axis=0)

@@ -76,7 +76,7 @@ class EpochTables:
             tables.append(t)
             oks.append(ok)
         self.pub_keys = list(pub_keys)
-        self.tables = np.stack(tables) if tables else np.zeros((0, 16, 4, 32), np.int32)
+        self.tables = np.stack(tables) if tables else np.zeros((0, 16, 4, fe.NLIMB), np.int32)
         self.key_ok = np.array(oks, dtype=bool)
         # [V, 32] uint8 key bytes for the native batch prep's per-vote
         # gather. Malformed key lengths (key_ok already False -> the vote is
@@ -130,15 +130,15 @@ def prepare_batch(
         )
         s_nib[i] = curve.scalar_to_nibbles(s)
         h_nib[i] = curve.scalar_to_nibbles(h)
-        r_limbs = fe.bytes_to_limbs(sig[:32])
-        r_sign[i] = r_limbs[31] >> 7
-        r_y[i] = r_limbs
-        r_y[i, 31] &= 0x7F
+        r_bytes = bytearray(sig[:32])
+        r_sign[i] = r_bytes[31] >> 7
+        r_bytes[31] &= 0x7F  # low 255 bits only (radix-agnostic: byte level)
+        r_y[i] = fe.bytes_to_limbs(bytes(r_bytes))
         pre_ok[i] = True
     a_tables = (
         epoch.tables[np.clip(val_idx, 0, max(len(epoch.pub_keys) - 1, 0))]
         if len(epoch.pub_keys)
-        else np.zeros((n, 16, 4, 32), np.int32)
+        else np.zeros((n, 16, 4, fe.NLIMB), np.int32)
     )
     return PreparedBatch(s_nib, h_nib, a_tables, r_y, r_sign, pre_ok)
 
@@ -321,7 +321,7 @@ def verify_kernel_gather(
         axis_name=axis_name,
     )
     y, x_parity = curve.ext_encode(p)
-    enc_match = fe.fe_is_equal_frozen(y, r_y.astype(jnp.int32)) & (
+    enc_match = fe.fe_is_equal_frozen(y, fe.bytes_to_limbs_device(r_y)) & (
         x_parity == r_sign.astype(jnp.int32)
     )
     return enc_match & pre_ok

@@ -32,6 +32,8 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from . import _fe_common as _common
+
 NLIMB = 32
 RADIX = 8
 MASK = (1 << RADIX) - 1
@@ -98,33 +100,13 @@ def fe_sub(a, b):
 import os
 
 
-def _conv_mode() -> str:
-    """Limb-convolution formulation, chosen at trace time per backend.
-
-    'pad'    — 32 shifted multiply-accumulates (elementwise + static pads).
-               On TPU this fuses into pure VPU code with NO layout changes;
-               the einsum formulation spent 44% of kernel time in reshapes
-               XLA inserted around the batched matvec (r3 profile), and
-               switching to 'pad' took the verify kernel from 16k to 57k
-               votes/s at B=4096 (85k at 16384).
-    'gather' — anti-diagonal gather + einsum. Same speed as 'pad' on CPU
-               but ~3x faster to compile; kept for CPU/test runs.
-    """
-    forced = os.environ.get("TXFLOW_FE_CONV")
-    if forced:
-        return forced
-    import jax
-
-    return "pad" if jax.default_backend() == "tpu" else "gather"
-
-
 def fe_mul(a, b):
     """Product mod 2^255-19 (normalized limbs). Inputs: limbs <= 1311.
 
     32x32 limb convolution (formulation per ``_conv_mode``), then the
     2^256 ≡ 38 fold of the high 31 columns, then carries.
     """
-    if _conv_mode() == "pad":
+    if _common.conv_mode() == "pad":
         nd = a.ndim
         c = None
         for i in range(NLIMB):
@@ -176,33 +158,42 @@ def fe_freeze(x):
     return fe_carry(x, passes=2)
 
 
-def fe_is_equal_frozen(a, b):
-    """Bytewise equality of two frozen elements -> bool[...]."""
-    return jnp.all(a == b, axis=-1)
+def bytes_to_limbs_device(b):
+    """[..., 32] uint8 LE bytes -> [..., NLIMB] int32 limbs (jit-able).
+    Radix 2^8: limbs ARE the bytes."""
+    return jnp.asarray(b).astype(jnp.int32)
 
 
-def fe_parity_frozen(a):
-    """Low bit of a frozen element (the encode() sign source)."""
-    return a[..., 0] & 1
+fe_is_equal_frozen = _common.fe_is_equal_frozen
+fe_parity_frozen = _common.fe_parity_frozen
+fe_inv = _common.make_inv(fe_mul)
 
 
-def fe_inv(a):
-    """a^(p-2) via the standard 25519 addition chain (~254 sq + 11 mul)."""
+# ---------------------------------------------------------------------------
+# Radix switch: TXFLOW_FE_RADIX=13 swaps in the 20-limb radix-2^13
+# implementation (fe13.py) for the whole process — curve tables, epoch
+# tables, and kernels all build on these symbols at import time, so the
+# choice must be made before anything imports ops.curve. Default stays
+# radix-8 (the TPU-measured configuration) until a live A/B on hardware
+# confirms the 20-limb kernel; bench.py exposes the knob.
+if os.environ.get("TXFLOW_FE_RADIX") == "13":
+    from . import fe13 as _fe13
 
-    def pow2k(x, k):
-        for _ in range(k):
-            x = fe_sq(x)
-        return x
-
-    z2 = fe_sq(a)  # 2
-    z9 = fe_mul(pow2k(z2, 2), a)  # 9
-    z11 = fe_mul(z9, z2)  # 11
-    z2_5_0 = fe_mul(fe_sq(z11), z9)  # 2^5 - 2^0
-    z2_10_0 = fe_mul(pow2k(z2_5_0, 5), z2_5_0)
-    z2_20_0 = fe_mul(pow2k(z2_10_0, 10), z2_10_0)
-    z2_40_0 = fe_mul(pow2k(z2_20_0, 20), z2_20_0)
-    z2_50_0 = fe_mul(pow2k(z2_40_0, 10), z2_10_0)
-    z2_100_0 = fe_mul(pow2k(z2_50_0, 50), z2_50_0)
-    z2_200_0 = fe_mul(pow2k(z2_100_0, 100), z2_100_0)
-    z2_250_0 = fe_mul(pow2k(z2_200_0, 50), z2_50_0)
-    return fe_mul(pow2k(z2_250_0, 5), z11)  # 2^255 - 21
+    NLIMB = _fe13.NLIMB
+    RADIX = _fe13.RADIX
+    MASK = _fe13.MASK
+    P_LIMBS = _fe13.P_LIMBS
+    int_to_limbs = _fe13.int_to_limbs
+    limbs_to_int = _fe13.limbs_to_int
+    bytes_to_limbs = _fe13.bytes_to_limbs
+    bytes_to_limbs_device = _fe13.bytes_to_limbs_device
+    fe_carry = _fe13.fe_carry
+    fe_add = _fe13.fe_add
+    fe_sub = _fe13.fe_sub
+    fe_mul = _fe13.fe_mul
+    fe_sq = _fe13.fe_sq
+    fe_mul_small = _fe13.fe_mul_small
+    fe_freeze = _fe13.fe_freeze
+    fe_is_equal_frozen = _fe13.fe_is_equal_frozen
+    fe_parity_frozen = _fe13.fe_parity_frozen
+    fe_inv = _fe13.fe_inv

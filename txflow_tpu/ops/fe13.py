@@ -1,0 +1,180 @@
+"""Batched GF(2^255-19) arithmetic in radix-2^13 int32 limbs (20 limbs).
+
+The radix upgrade over ``fe`` (radix-2^8, 32 limbs): a field element is
+``[..., 20]`` int32 — the limb convolution shrinks from 32x32 to 20x20
+partial products (~2.5x fewer multiplies), the main lever for lifting the
+verify kernel past the r3 36-39k votes/s plateau. Same API as ``fe``;
+``fe`` re-exports this implementation when TXFLOW_FE_RADIX=13.
+
+Bounds discipline (the radix-8 comments generalized; checked by
+tests/test_fe13.py):
+
+- 20 * 13 = 260 bits: the top limb carries 8 canonical bits; 2^260 ≡ 608
+  (= 2^5 * 19) is the carry wraparound constant.
+- "normalized": limbs <= N = 9408 (= 2^13 - 1 + 2*608 + margin). A 20-col
+  convolution of two normalized inputs peaks at 20 * N^2 = 1.77e9 < 2^31,
+  so the conv stays in int32 — but ONLY for normalized inputs, which is
+  why fe_add carries its output here (radix-8 could defer).
+- The 2^260 fold must pre-carry the high columns BEFORE multiplying by
+  608: high columns reach ~2^30.7, and 608 * 2^30.7 would overflow int32.
+  After a 3-pass pre-carry they are < 2^13.2, and 608 * that folds safely.
+- fe_sub offsets by 128*p (limbwise): the radix-8 code used 8*p, but p's
+  top limb here is 255, and 8 * 255 = 2040 cannot dominate a normalized
+  subtrahend limb (<= 9408); 128 * 255 = 32640 can.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import _fe_common as _common
+
+NLIMB = 20
+RADIX = 13
+MASK = (1 << RADIX) - 1
+
+P_INT = 2**255 - 19
+WRAP = 608  # 2^260 mod p
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Host helper: python int -> canonical limb vector."""
+    return np.array([(x >> (RADIX * i)) & MASK for i in range(NLIMB)], dtype=np.int32)
+
+
+def limbs_to_int(limbs) -> int:
+    """Host helper: limb vector (any bounds) -> python int."""
+    out = 0
+    for i, v in enumerate(np.asarray(limbs).tolist()):
+        out += int(v) << (RADIX * i)
+    return out
+
+
+P_LIMBS = int_to_limbs(P_INT)  # [8173, 8191*18, 255]
+OFFSET_P_LIMBS = 128 * P_LIMBS
+
+
+def bytes_to_limbs(b: bytes) -> np.ndarray:
+    assert len(b) == 32
+    return int_to_limbs(int.from_bytes(b, "little"))
+
+
+# 13-bit repack plan: limb j spans bytes (13j)//8 .. +2 at offset (13j)%8.
+_J = np.arange(NLIMB)
+_BYTE0 = (13 * _J) // 8
+_OFF = (13 * _J) % 8
+
+
+def bytes_to_limbs_device(b):
+    """[..., 32] uint8 LE bytes -> [..., 20] int32 limbs (jit-able)."""
+    b = jnp.asarray(b).astype(jnp.int32)
+    bp = jnp.pad(b, [(0, 0)] * (b.ndim - 1) + [(0, 2)])
+    w = (
+        bp[..., jnp.asarray(_BYTE0)]
+        | (bp[..., jnp.asarray(_BYTE0 + 1)] << 8)
+        | (bp[..., jnp.asarray(_BYTE0 + 2)] << 16)
+    )
+    return (w >> jnp.asarray(_OFF)) & MASK
+
+
+def fe_carry(x, passes: int = 4):
+    """Data-parallel carry with the 2^260 ≡ 608 wraparound."""
+    for _ in range(passes):
+        hi = x >> RADIX
+        lo = x & MASK
+        wrapped = jnp.concatenate(
+            [WRAP * hi[..., NLIMB - 1 :], hi[..., : NLIMB - 1]], axis=-1
+        )
+        x = lo + wrapped
+    return x
+
+
+def fe_add(a, b):
+    """a + b, CARRIED (unlike radix-8): the sum of two normalized values
+    would breach the 20 * limb^2 < 2^31 conv bound if fed to fe_mul raw."""
+    return fe_carry(a + b, passes=1)
+
+
+def fe_sub(a, b):
+    """a - b mod p, borrow-free via the 128p offset; output normalized."""
+    return fe_carry(a + jnp.asarray(OFFSET_P_LIMBS) - b, passes=2)
+
+
+# Anti-diagonal gather plan (CPU/compile-fast formulation; the padded
+# multiply-accumulate is the TPU formulation — see fe._conv_mode).
+_K = np.arange(2 * NLIMB - 1)
+_I = np.arange(NLIMB)
+_IDX = np.clip(_K[None, :] - _I[:, None], 0, NLIMB - 1)
+_VALID = (_K[None, :] - _I[:, None] >= 0) & (_K[None, :] - _I[:, None] < NLIMB)
+
+
+def fe_mul(a, b):
+    """Product mod 2^255-19. Inputs normalized (limbs <= ~9408).
+
+    20x20 limb convolution (formulation per ``_conv_mode``), 3-pass
+    pre-carry of the high columns, 2^260 ≡ 608 fold, 4 carry passes.
+    """
+    if _common.conv_mode() == "pad":
+        nd = a.ndim
+        c = None
+        for i in range(NLIMB):
+            t = jnp.pad(
+                a[..., i : i + 1] * b, [(0, 0)] * (nd - 1) + [(i, NLIMB - 1 - i)]
+            )
+            c = t if c is None else c + t
+    else:
+        bsh = jnp.where(jnp.asarray(_VALID), b[..., jnp.asarray(_IDX)], 0)
+        c = jnp.einsum("...i,...ik->...k", a, bsh)  # [..., 39]
+    lo = c[..., :NLIMB]
+    hi = jnp.pad(c[..., NLIMB:], [(0, 0)] * (c.ndim - 1) + [(0, 1)])
+    # pre-carry BEFORE the 608 fold (int32 overflow otherwise — module
+    # docstring); the tiny residual above bit 260 wraps via fe_carry's own
+    # 608 term, which is exact: hi's value is multiplied by 608 afterwards
+    # and 608 * (x mod p) ≡ 608 * x (mod p)
+    hi = fe_carry(hi, passes=3)
+    return fe_carry(lo + WRAP * hi, passes=4)
+
+
+def fe_sq(a):
+    return fe_mul(a, a)
+
+
+def fe_mul_small(a, c: int):
+    """Multiply by a small constant (c * 9408 must stay < 2^31: c <= ~2^17)."""
+    assert c <= (1 << 17)
+    return fe_carry(a * c)
+
+
+def fe_freeze(x):
+    """Exact canonical reduction: limbs in [0, 2^13) and value < p.
+
+    After carrying, the value can reach ~2^260.1 (top limb holds 13 bits
+    where only 8 are canonical): fold bits >= 255 back via 2^255 ≡ 19,
+    twice (the second pass handles the fold's own carry), then at most two
+    conditional subtractions of p land in [0, p).
+    """
+    x = fe_carry(x, passes=5)
+    for _ in range(2):
+        t = x[..., NLIMB - 1] >> 8  # bits 255.. of the value
+        x = x.at[..., NLIMB - 1].set(x[..., NLIMB - 1] & 0xFF)
+        x = x.at[..., 0].add(19 * t)
+        x = fe_carry(x, passes=2)
+    p = jnp.asarray(P_LIMBS)
+    for _ in range(2):
+        diff = x - p
+        borrows = []
+        borrow = jnp.zeros_like(x[..., 0])
+        for i in range(NLIMB):
+            d = diff[..., i] - borrow
+            borrow = (d < 0).astype(x.dtype)
+            borrows.append(d + (borrow << RADIX))
+        sub = jnp.stack(borrows, axis=-1)
+        x = jnp.where((borrow == 0)[..., None], sub, x)
+    return fe_carry(x, passes=2)
+
+
+fe_is_equal_frozen = _common.fe_is_equal_frozen
+fe_parity_frozen = _common.fe_parity_frozen
+fe_inv = _common.make_inv(fe_mul)

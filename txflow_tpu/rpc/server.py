@@ -172,7 +172,8 @@ class RPCServer:
                     except OSError:
                         pass
                     return
-                if self.headers.get("Transfer-Encoding"):
+                chunked = bool(self.headers.get("Transfer-Encoding"))
+                if chunked:
                     # chunked bodies are not parsed: dispatch, then drop
                     # the connection so unread chunk bytes can never be
                     # misread as the next request line (and the size cap
@@ -187,6 +188,19 @@ class RPCServer:
                 except OSError:
                     pass
                 self.do_GET()
+                if chunked:
+                    # bounded drain of unread chunk bytes before close —
+                    # close() with data in the receive buffer emits RST,
+                    # which can destroy the response in flight (same
+                    # hazard the 413 path drains for)
+                    try:
+                        self.wfile.flush()
+                        self.connection.settimeout(2)
+                        for _ in range(64):
+                            if not self.rfile.read(65536):
+                                break
+                    except OSError:
+                        pass
 
             def do_GET(self):
                 try:
