@@ -460,3 +460,15 @@ def test_verify_cache_binds_pubkey_not_index():
     k1 = VerifyCache.key(msg, sig, pub_a)
     k2 = VerifyCache.key(msg + sig[:1], sig[1:], pub_a)
     assert k1 != k2
+
+
+@pytest.mark.parametrize("nv", [16, 64])
+def test_large_validator_set_parity(nv):
+    """Device/scalar parity at BASELINE configs 2-3 validator counts (the
+    [V,16,4,NLIMB] epoch-table gather at V=16/64 — the shapes the TPU
+    bench sweeps; adversarial mix included)."""
+    vals, seeds = make_valset(nv)
+    msgs, sigs, vidx, slot = make_batch(
+        vals, seeds, n_txs=3, corrupt=("ok", "flip", "ok", "wrongkey")
+    )
+    assert_parity(vals, msgs, sigs, vidx, slot, 3)

@@ -1,0 +1,68 @@
+#!/bin/bash
+# Round-5 TPU watcher: probe until the tunnel is healthy, then immediately
+# bank a full bench run (bench.py banks TPU artifacts itself). Keeps watching
+# and refreshes the banked number every ~45 min while healthy.
+cd /root/repo
+LOG=/tmp/tpu_watch_r5.log
+LAST_BENCH=0
+while true; do
+  out=$(timeout -k 5 90 python -c "
+import os
+os.environ.setdefault('JAX_COMPILATION_CACHE_DIR', os.path.abspath('.jax_cache'))
+import jax, jax.numpy as jnp, time
+t0=time.time()
+y = jax.jit(lambda a: a@a)(jnp.ones((256,256), jnp.bfloat16)).block_until_ready()
+print('TPU_OK', round(time.time()-t0,1))
+" 2>/dev/null | grep TPU_OK)
+  echo "$(date +%H:%M:%S) ${out:-degraded}" >> "$LOG"
+  if [ -n "$out" ]; then
+    now=$(date +%s)
+    if [ $((now - LAST_BENCH)) -gt 2700 ]; then
+      echo "$(date +%H:%M:%S) healthy: prewarm + bench" >> "$LOG"
+      timeout -k 5 900 python -c "
+import __graft_entry__ as g, jax, time
+t0=time.time()
+fn, args = g.entry()
+jax.jit(fn)(*args)
+print('entry warm', round(time.time()-t0,1))
+" >> "$LOG" 2>&1
+      timeout -k 5 3600 python bench.py > /tmp/bench_tpu_r5.json 2>>"$LOG"
+      echo "$(date +%H:%M:%S) bench rc=$? :: $(cat /tmp/bench_tpu_r5.json | head -c 400)" >> "$LOG"
+      # radix A/B: kernel-only device step speed under both field radixes
+      for R in 8 13; do
+        timeout -k 5 900 env TXFLOW_FE_RADIX=$R python -c "
+import hashlib, time, numpy as np, jax, jax.numpy as jnp
+from txflow_tpu.crypto import ed25519 as host_ed
+from txflow_tpu.ops import fe, ed25519_batch
+B = 16384
+seeds = [hashlib.sha256(b'ab-%d' % i).digest() for i in range(4)]
+pubs = [host_ed.public_key_from_seed(s) for s in seeds]
+epoch = ed25519_batch.EpochTables(pubs)
+msgs = [b'ab-msg-%d' % i for i in range(B)]
+sigs = [host_ed.sign(seeds[i % 4], m) for i, m in enumerate(msgs)]
+cb = ed25519_batch.prepare_compact(msgs, sigs, np.arange(B) % 4, epoch)
+tables = jnp.asarray(epoch.tables)
+args = [jnp.asarray(cb.s_nibbles), jnp.asarray(cb.h_nibbles), jnp.asarray(cb.val_idx.astype(np.int32)), tables, jnp.asarray(cb.r_y), jnp.asarray(cb.r_sign), jnp.asarray(cb.pre_ok)]
+k = jax.jit(ed25519_batch.verify_kernel_gather)
+r = np.asarray(k(*args)); assert r.all()
+t0 = time.time()
+for _ in range(3): k(*args)[0].block_until_ready()
+dt = (time.time()-t0)/3
+print('TPU kernel radix %d: %.0f votes/s at B=%d' % (fe.RADIX, B/dt, B))
+" >> "$LOG" 2>&1
+      done
+      # BASELINE configs: 16-val (config 2), 64-val (config 3), consensus-on
+      # (config 5) — the judge's still-unmeasured table rows (r4 items 3)
+      for CFG in "BENCH_VALIDATORS=16:cfg2_16val" "BENCH_VALIDATORS=64:cfg3_64val" "BENCH_CONSENSUS=1:cfg5_consensus"; do
+        SPEC="${CFG%%:*}"; NAME="${CFG##*:}"
+        echo "$(date +%H:%M:%S) running $NAME" >> "$LOG"
+        timeout -k 5 3600 env "$SPEC" BENCH_LATENCY_SWEEP=0 python bench.py           > "bench_artifacts/tpu_${NAME}_r5.json" 2>>"$LOG"
+        echo "$(date +%H:%M:%S) $NAME rc=$? :: $(head -c 300 bench_artifacts/tpu_${NAME}_r5.json)" >> "$LOG"
+      done
+      LAST_BENCH=$(date +%s)
+    fi
+    sleep 300
+  else
+    sleep 120
+  fi
+done
