@@ -298,6 +298,7 @@ def run_bench(platform: str) -> dict:
     # measured on-TPU: per-tx fencing (1) beat interval 16 end-to-end
     # (12.7k vs 9.7k votes/s) — the fence is not the binding cost there
     cfg.engine.commit_interval = int(os.environ.get("BENCH_COMMIT_INTERVAL", "1"))
+    cfg.engine.idle_flush = float(os.environ.get("BENCH_IDLE_FLUSH", cfg.engine.idle_flush))
 
     # BASELINE config 5: BENCH_CONSENSUS=1 runs the block-path ticker
     # DURING the vote flood (blocks carry the fast-path commits as Vtxs).
@@ -600,9 +601,10 @@ def main():
                 "result cache across the nodes' engines"
             )
             same_platform = companion.get("platform") == result.get("platform")
-            if companion.get("value") and same_platform:
+            if companion.get("value") is not None and same_platform:
                 # the honest baseline comparison: the Go reference cannot
-                # share verifies across nodes
+                # share verifies across nodes (a 0.0 value is a real —
+                # bad — measurement, not a failure)
                 result["value_no_shared_cache"] = companion["value"]
                 result["vs_baseline"] = round(
                     companion["value"] / BASELINE_VOTES_PER_SEC, 3
@@ -614,12 +616,15 @@ def main():
                 # comparison this companion exists to prevent — say so
                 # instead of keeping the shared-cache ratio
                 result["vs_baseline"] = None
-                result["no_cache_companion_error"] = companion.get(
-                    "error"
-                ) or (
-                    "companion platform %r != %r"
-                    % (companion.get("platform"), result.get("platform"))
-                )
+                if companion.get("error"):
+                    result["no_cache_companion_error"] = companion["error"]
+                elif not same_platform:
+                    result["no_cache_companion_error"] = (
+                        "companion platform %r != %r"
+                        % (companion.get("platform"), result.get("platform"))
+                    )
+                else:
+                    result["no_cache_companion_error"] = "companion returned no value"
     except Exception as e:
         if platform != "cpu" and os.environ.get("BENCH_PLATFORM") != "cpu":
             # TPU path failed mid-run: re-exec once on CPU so the driver
@@ -642,9 +647,12 @@ def main():
         result.get("platform") not in (None, "cpu")
         and result.get("value", 0) > 0
         and os.environ.get("BENCH_COMPANION") != "1"
+        and os.environ.get("BENCH_VALIDATORS", "4") == "4"
+        and os.environ.get("BENCH_CONSENSUS", "0") != "1"
     ):
-        # the throughput-only no-cache companion must never overwrite the
-        # banked default-config measurement
+        # only the DEFAULT config banks: the no-cache companion and the
+        # 16/64-validator / consensus-on sweep runs must never overwrite
+        # the banked default-config measurement
         _bank_tpu_result(result)
     elif result.get("platform") == "cpu" and (
         _PROBE_DIAGNOSTICS or os.environ.get("BENCH_TPU_FELL_BACK") == "1"

@@ -75,6 +75,7 @@ def main() -> None:
     cfg.engine.min_batch = int(os.environ.get("BENCH_MIN_BATCH", "3072"))
     cfg.engine.batch_wait = float(os.environ.get("BENCH_BATCH_WAIT", "0.05"))
     cfg.engine.commit_interval = int(os.environ.get("BENCH_COMMIT_INTERVAL", "1"))
+    cfg.engine.idle_flush = float(os.environ.get("BENCH_IDLE_FLUSH", cfg.engine.idle_flush))
 
     net = LocalNet(
         n_vals,

@@ -658,3 +658,65 @@ def test_sync_pump_fills_window_across_peers():
         assert not a.sent and not b.sent
     finally:
         pass  # never started: nothing to stop
+
+
+def test_forged_parts_header_rejected():
+    """A parts header without the current proposer's valid signature must
+    not open an assembly buffer (unauthenticated first-header-wins would
+    let anyone block assembly of the real proposal — r5 review), and an
+    over-large part count is rejected outright."""
+    import json as _json
+
+    from txflow_tpu.consensus.reactor import MSG_PROPOSAL
+    from txflow_tpu.types.part_set import make_part_set
+
+    cfg = make_test_config()
+    net = LocalNet(4, use_device_verifier=False, enable_consensus=True, config=cfg)
+    node = net.nodes[0]  # constructed, not started: stable round state
+    reactor = node.consensus_reactor
+
+    class FakePeer:
+        node_id = "forger"
+
+        def __init__(self):
+            self.kv = {}
+
+        def set(self, k, v):
+            self.kv[k] = v
+
+        def get(self, k, default=None):
+            return self.kv.get(k, default)
+
+        def try_send(self, chan, msg):
+            return True
+
+    rs = node.consensus.round_state()
+    # distinct part contents (identical parts would make the reversed-
+    # hashes probe below a no-op)
+    header, _ = make_part_set(
+        b"".join(bytes([i]) * 512 for i in range(4)), part_size=512
+    )
+    forged = {
+        "height": rs.height, "round": rs.round, "pol_round": -1,
+        "block_hash": ("ab" * 32), "ts": 0, "sig": "cc" * 64,
+        "parts": header.to_wire(),
+    }
+    reactor.receive(
+        0x20, FakePeer(), bytes([MSG_PROPOSAL]) + _json.dumps(forged).encode()
+    )
+    assert reactor._part_bufs == {}, "forged header opened an assembly buffer"
+
+    # header whose hash list disagrees with its root is invalid outright
+    bad = dict(forged)
+    bad_parts = header.to_wire()
+    bad_parts["hashes"] = list(reversed(bad_parts["hashes"]))
+    bad["parts"] = bad_parts
+    try:
+        reactor.receive(
+            0x20, FakePeer(), bytes([MSG_PROPOSAL]) + _json.dumps(bad).encode()
+        )
+        raised = False
+    except ValueError:
+        raised = True
+    assert raised, "inconsistent part-set header accepted"
+    assert reactor._part_bufs == {}

@@ -56,13 +56,13 @@ print('TPU kernel radix %d: %.0f votes/s at B=%d' % (fe.RADIX, B/dt, B))
       for CFG in "BENCH_VALIDATORS=16:cfg2_16val" "BENCH_VALIDATORS=64:cfg3_64val" "BENCH_CONSENSUS=1:cfg5_consensus"; do
         SPEC="${CFG%%:*}"; NAME="${CFG##*:}"
         echo "$(date +%H:%M:%S) running $NAME" >> "$LOG"
-        timeout -k 5 3600 env "$SPEC" BENCH_LATENCY_SWEEP=0 python bench.py           > "bench_artifacts/tpu_${NAME}_r5.json" 2>>"$LOG"
+        timeout -k 5 3600 env "$SPEC" BENCH_LATENCY=0 python bench.py           > "bench_artifacts/tpu_${NAME}_r5.json" 2>>"$LOG"
         echo "$(date +%H:%M:%S) $NAME rc=$? :: $(head -c 300 bench_artifacts/tpu_${NAME}_r5.json)" >> "$LOG"
       done
       LAST_BENCH=$(date +%s)
     fi
     sleep 300
   else
-    sleep 120
+    sleep 300
   fi
 done
