@@ -158,3 +158,98 @@ def test_malformed_pubkey_length_does_not_misalign_epoch():
     for nib in a.h_nibbles[2]:
         got = (got << 4) | int(nib)
     assert got == want
+
+
+def test_sign_bytes_batch_parity():
+    """Native batch sign bytes are byte-identical to the Python encoder
+    across edge cases: zero height, empty hash/chain, negative and huge
+    timestamps, varint boundary values."""
+    import time as _t
+
+    from txflow_tpu import native
+    from txflow_tpu.types.tx_vote import canonical_sign_bytes
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("no C compiler")
+
+    cases = [
+        (0, "", 0, ""),
+        (1, "AB" * 32, 1700000000_000000000, "chain-x"),
+        (2**62, "FF" * 32, -1, "c"),
+        (127, "00" * 32, 999_999_999, "txflow-localnet"),
+        (128, "CD" * 32, 1_000_000_000, ""),
+        (7, "E" * 10, -1_500_000_001, "n" * 100),
+        (0, "AA" * 32, _t.time_ns(), "txflow-bench"),
+    ]
+    batch = native.sign_bytes_batch(
+        [h for h, _, _, _ in cases],
+        [x for _, x, _, _ in cases],
+        [t for _, _, t, _ in cases],
+        "shared-chain",
+    )
+    assert batch is not None
+    for (h, x, t, _), got in zip(cases, batch):
+        assert got == canonical_sign_bytes("shared-chain", h, x, t), (h, x, t)
+    # per-case chain ids too (the engine always uses one chain, but the
+    # helper must not silently assume it)
+    for h, x, t, c in cases:
+        got = native.sign_bytes_batch([h], [x], [t], c)
+        assert got is not None and got[0] == canonical_sign_bytes(c, h, x, t)
+
+
+def test_sign_bytes_many_primes_cache():
+    """sign_bytes_many returns the same bytes as per-vote sign_bytes and
+    primes the per-vote cache for signed votes."""
+    import hashlib
+
+    from txflow_tpu.types import TxVote
+    from txflow_tpu.types.priv_validator import MockPV
+    from txflow_tpu.types.tx_vote import sign_bytes_many
+
+    pv = MockPV()
+    votes = []
+    for i in range(8):
+        key = hashlib.sha256(b"sbm-%d" % i).digest()
+        v = TxVote(height=0, tx_hash=key.hex().upper(), tx_key=key,
+                   validator_address=pv.get_address())
+        pv.sign_tx_vote("chain-sbm", v)
+        votes.append(v)
+    expect = [canonical_expected.sign_bytes("chain-sbm") for canonical_expected in [v.copy() for v in votes]]
+    got = sign_bytes_many(votes, "chain-sbm")
+    assert got == expect
+    # cache primed: second call is pure cache hits (no native needed)
+    assert sign_bytes_many(votes, "chain-sbm") == expect
+    assert all(v._sb_cache is not None for v in votes)
+
+
+def test_sign_bytes_batch_hostile_lengths_safe():
+    """Attacker-length fields must never reach the C stack buffer (r5
+    review: a gossiped vote with a 5000-char tx_hash segfaulted the
+    process pre-signature-check). Oversized items come back as None and
+    the sign_bytes_many path falls back to Python for them, bytes-equal."""
+    from txflow_tpu import native
+    from txflow_tpu.types import TxVote
+    from txflow_tpu.types.tx_vote import canonical_sign_bytes, sign_bytes_many
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("no C compiler")
+
+    evil_hash = "A" * 5000
+    batch = native.sign_bytes_batch([1], [evil_hash], [123], "chain")
+    assert batch is not None and batch[0] is None  # rejected, no crash
+    # oversized chain id likewise
+    batch = native.sign_bytes_batch([1], ["AB" * 32], [123], "c" * 4096)
+    assert batch is not None and batch[0] is None
+    # mixed batch: the hostile item falls back, the honest one is native;
+    # both byte-equal to the Python encoder
+    v_evil = TxVote(height=1, tx_hash=evil_hash, tx_key=b"\x00" * 32,
+                    timestamp_ns=123, validator_address=b"\x01" * 20)
+    v_ok = TxVote(height=1, tx_hash="CD" * 32, tx_key=b"\x00" * 32,
+                  timestamp_ns=456, validator_address=b"\x01" * 20)
+    got = sign_bytes_many([v_evil, v_ok], "chain-h")
+    assert got[0] == canonical_sign_bytes("chain-h", 1, evil_hash, 123)
+    assert got[1] == canonical_sign_bytes("chain-h", 1, "CD" * 32, 456)

@@ -429,8 +429,14 @@ def test_rpc_hardening_body_cap_and_connection_cap():
         # -- connection flood: at most MAX_OPEN_CONNECTIONS serviced --
         old_cap = rpcmod.MAX_OPEN_CONNECTIONS
         sem = net.nodes[0].rpc._httpd._conn_sem
-        # shrink the live semaphore to a tiny cap for the test
+        # shrink the live semaphore to a tiny cap for the test; drain
+        # twice with a settle gap — a handler thread from an earlier
+        # request in this test may release its permit AFTER the first
+        # drain, silently raising the effective capacity (flake)
         drained = 0
+        while sem.acquire(blocking=False):
+            drained += 1
+        time.sleep(0.3)
         while sem.acquire(blocking=False):
             drained += 1
         for _ in range(2):  # leave capacity 2

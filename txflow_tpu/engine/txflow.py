@@ -104,6 +104,12 @@ class TxFlow:
         # the next device verify instead of serializing behind it
         self._commit_q: _queue.SimpleQueue = _queue.SimpleQueue()
         self._committer: threading.Thread | None = None
+        # decision/apply lag accounting: a certificate exists (TxStore,
+        # _committed mark) the moment a quorum is DECIDED, while the ABCI
+        # apply runs on the committer thread a beat later — these counters
+        # let callers wait for the apply side to drain (commits_drained)
+        self._decided_count = 0
+        self._applied_count = 0
         self.app_hash = b""
 
     # ---- lifecycle (reference OnStart :80-87) ----
@@ -244,7 +250,9 @@ class TxFlow:
                 if vs is not None:
                     prior[s] = vs.stake()
 
-            msgs = [v.sign_bytes(self.chain_id) for v in votes]
+            from ..types.tx_vote import sign_bytes_many
+
+            msgs = sign_bytes_many(votes, self.chain_id)
             sigs = [v.signature or b"" for v in votes]
             val_idx = np.array(
                 [self._addr_to_idx.get(v.validator_address, -1) for v in votes],
@@ -357,6 +365,7 @@ class TxFlow:
         a late get_tx(None) would silently drop the apply."""
         self.vote_sets.pop(vs.tx_hash, None)
         self._committed.push(_hash_key(vs.tx_hash))
+        self._decided_count += 1
         self._commit_q.put(
             (vs, vs.votes_snapshot(), self.mempool.get_tx(vs.tx_key))
         )
@@ -460,6 +469,7 @@ class TxFlow:
             if tx is not None:
                 apply_items.append((vs, tx))
         if not apply_items:
+            self._applied_count += len(items)
             return
         for base in range(0, len(apply_items), interval):
             group = apply_items[base : base + interval]
@@ -479,6 +489,15 @@ class TxFlow:
         self.commitpool.push_committed_many(
             [tx for _, tx in apply_items], [vs.tx_key for vs, _ in apply_items]
         )
+        self._applied_count += len(items)
+
+    def commits_drained(self) -> bool:
+        """True when every decided commit has been applied (the pipelined
+        committer's queue is empty AND its in-flight wake finished).
+        Decision-time facts (certificates, is_tx_committed) lead the ABCI
+        app state by the pipeline depth; tests/operators comparing app
+        hashes across nodes must wait for this."""
+        return self._applied_count >= self._decided_count
 
     def is_tx_committed(self, tx_hash: str) -> bool:
         """Committed via EITHER path: the fast path (TxStore certificate)

@@ -20,6 +20,7 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "prep.c")
+_SRC_CODEC = os.path.join(_DIR, "codec.c")
 _SO = os.path.join(_DIR, "_prep.so")
 
 _lock = threading.Lock()
@@ -29,8 +30,15 @@ _tried = False
 
 def _build() -> bool:
     """Compile prep.c -> _prep.so if missing or stale. True on success."""
+    # codec.c is optional: a tree without it still builds prep.c alone
+    # (sign_bytes_batch then reports unavailable via the hasattr check)
+    srcs = [s for s in (_SRC, _SRC_CODEC) if os.path.exists(s)]
+    if not srcs:
+        return False
     try:
-        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        if os.path.exists(_SO) and os.path.getmtime(_SO) >= max(
+            os.path.getmtime(s) for s in srcs
+        ):
             return True
     except OSError:
         return False
@@ -38,7 +46,7 @@ def _build() -> bool:
     for cc in ("cc", "gcc", "g++"):
         try:
             r = subprocess.run(
-                [cc, "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+                [cc, "-O3", "-shared", "-fPIC", "-o", tmp] + srcs,
                 capture_output=True,
                 timeout=120,
             )
@@ -74,6 +82,16 @@ def _load():
         lib.txflow_prep_batch.restype = None
         lib.txflow_sha512.argtypes = [u8p, ctypes.c_size_t, u8p]
         lib.txflow_sha512.restype = None
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.txflow_sign_bytes_batch.argtypes = [
+            ctypes.c_int64,  # n_votes
+            i64p,  # heights
+            u8p, ctypes.c_int64, i32p,  # hashes, stride, lens
+            i64p,  # timestamps
+            u8p, ctypes.c_int32,  # chain, len
+            u8p, ctypes.c_int64, i32p,  # out, stride, lens
+        ]
+        lib.txflow_sign_bytes_batch.restype = None
         _lib = lib
         return _lib
 
@@ -129,3 +147,56 @@ def prep_batch(
         _u8p(ok),
     )
     return s_le, h_le, ok.astype(bool)
+
+
+def sign_bytes_batch(
+    heights: list[int],
+    tx_hashes: list[str],
+    timestamps: list[int],
+    chain_id: str,
+) -> list[bytes | None] | None:
+    """Batched canonical sign bytes (codec.c).
+
+    None when the native library is unavailable; otherwise a per-vote
+    list where an item is None if its fields exceed the C-side bounds
+    (hash > 256 chars / chain id > 128 bytes — possible only for hostile
+    votes; real hashes are 64 chars). Callers Python-fallback per item.
+    """
+    lib = _load()
+    if lib is None or not hasattr(lib, "txflow_sign_bytes_batch"):
+        return None
+    n = len(heights)
+    if n == 0:
+        return []
+    chain = chain_id.encode()
+    hb = [h.encode() for h in tx_hashes]
+    hash_stride = max(len(b) for b in hb) or 1
+    hashes = np.zeros((n, hash_stride), np.uint8)
+    hash_lens = np.zeros(n, np.int32)
+    for i, b in enumerate(hb):
+        hashes[i, : len(b)] = np.frombuffer(b, np.uint8)
+        hash_lens[i] = len(b)
+    out_stride = 96 + hash_stride + len(chain)
+    out = np.zeros((n, out_stride), np.uint8)
+    out_lens = np.zeros(n, np.int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.txflow_sign_bytes_batch(
+        n,
+        np.ascontiguousarray(heights, np.int64).ctypes.data_as(i64p),
+        _u8p(hashes), hash_stride, hash_lens.ctypes.data_as(i32p),
+        np.ascontiguousarray(timestamps, np.int64).ctypes.data_as(i64p),
+        _u8p(np.frombuffer(chain, np.uint8)) if chain else _u8p(np.zeros(1, np.uint8)),
+        len(chain),
+        _u8p(out), out_stride, out_lens.ctypes.data_as(i32p),
+    )
+    ob = out.tobytes()
+    # per-item None for oversized fields (the C side hard-rejects them —
+    # a hostile vote must only cost ITS OWN Python fallback, not the
+    # whole batch's)
+    return [
+        ob[i * out_stride : i * out_stride + out_lens[i]]
+        if out_lens[i] >= 0
+        else None
+        for i in range(n)
+    ]

@@ -119,6 +119,14 @@ class LocalNet:
                     if time.monotonic() > deadline:
                         return False
                     time.sleep(poll)
+        # certificates are decision-time facts; wait for the pipelined
+        # committers' ABCI applies to drain too, so callers can compare
+        # app state across nodes right after this returns
+        for node in self.nodes:
+            while not node.txflow.commits_drained():
+                if time.monotonic() > deadline:
+                    return False
+                time.sleep(poll)
         return True
 
     def committed_votes_total(self) -> int:

@@ -162,6 +162,49 @@ class TxVote:
         return k
 
 
+def sign_bytes_many(votes: list["TxVote"], chain_id: str) -> list[bytes]:
+    """Sign bytes for a whole drain batch, priming each vote's cache.
+
+    Cache misses batch through the native codec (native/codec.c, ~0.1 us
+    per vote vs ~4 us for the per-vote Python encode — a top-5 host cost
+    at bench rates, r5 profile); without a C compiler the Python path
+    computes them one by one, same bytes either way (parity pinned by
+    tests/test_native_prep.py)."""
+    out: list[bytes | None] = [None] * len(votes)
+    miss: list[int] = []
+    for i, v in enumerate(votes):
+        c = v._sb_cache
+        if c is not None and c[0] == chain_id:
+            out[i] = c[1]
+        else:
+            miss.append(i)
+    if miss:
+        from .. import native
+
+        batch = native.sign_bytes_batch(
+            [votes[i].height for i in miss],
+            [votes[i].tx_hash for i in miss],
+            [votes[i].timestamp_ns for i in miss],
+            chain_id,
+        )
+        if batch is not None:
+            for j, i in enumerate(miss):
+                if batch[j] is None:
+                    # field bounds exceeded (hostile vote): per-item
+                    # Python fallback — same bytes, no native fast path
+                    out[i] = votes[i].sign_bytes(chain_id)
+                    continue
+                out[i] = batch[j]
+                if votes[i].signature is not None:  # immutable once signed
+                    object.__setattr__(
+                        votes[i], "_sb_cache", (chain_id, batch[j])
+                    )
+        else:
+            for i in miss:
+                out[i] = votes[i].sign_bytes(chain_id)
+    return out  # type: ignore[return-value]
+
+
 def encode_tx_vote(vote: TxVote) -> bytes:
     """Amino MarshalBinaryBare of the full TxVote struct (WAL/wire form)."""
     if vote._wire_cache is not None:
