@@ -189,3 +189,72 @@ print("RADIX13 KERNEL PARITY OK")
     )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "RADIX13 KERNEL PARITY OK" in r.stdout
+
+
+def test_sharded_mesh_parity_radix13():
+    """The 8-device shard_map verify+tally path under TXFLOW_FE_RADIX=13:
+    decisions must match the scalar golden model (the radix swap must
+    compose with the vote-axis sharding, psum tally included). Subprocess:
+    the radix is an import-time choice."""
+    code = r"""
+import os
+os.environ["TXFLOW_FE_RADIX"] = "13"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+import hashlib
+import numpy as np
+from txflow_tpu.crypto import ed25519 as host_ed
+from txflow_tpu.ops import fe
+from txflow_tpu.parallel import make_mesh
+from txflow_tpu.types import TxVote, Validator, ValidatorSet, canonical_sign_bytes
+from txflow_tpu.verifier import DeviceVoteVerifier, ScalarVoteVerifier
+
+assert fe.NLIMB == 20
+
+seeds = [hashlib.sha256(b"m13-%d" % i).digest() for i in range(4)]
+pubs = [host_ed.public_key_from_seed(s) for s in seeds]
+vals = ValidatorSet([Validator.from_pub_key(p, 10) for p in pubs])
+seed_by_pub = dict(zip(pubs, seeds))
+seeds_sorted = [seed_by_pub[v.pub_key] for v in vals]
+
+msgs, sigs, vidx, slot = [], [], [], []
+for t in range(4):
+    h = hashlib.sha256(b"tx%d" % t).hexdigest().upper()
+    for vi in range(4):
+        m = canonical_sign_bytes("mesh13", 1, h, 1700000000_000000000 + t)
+        s = host_ed.sign(seeds_sorted[vi], m)
+        if (t * 4 + vi) % 5 == 3:
+            s = s[:12] + bytes([s[12] ^ 1]) + s[13:]  # corrupt some
+        msgs.append(m); sigs.append(s); vidx.append(vi); slot.append(t)
+
+mesh = make_mesh(8)
+dev = DeviceVoteVerifier(vals, mesh=mesh)
+sca = ScalarVoteVerifier(vals)
+rd = dev.verify_and_tally(msgs, sigs, np.array(vidx), np.array(slot), 4)
+rs = sca.verify_and_tally(msgs, sigs, np.array(vidx), np.array(slot), 4)
+np.testing.assert_array_equal(rd.valid, rs.valid)
+np.testing.assert_array_equal(rd.stake.astype(np.int64), rs.stake)
+np.testing.assert_array_equal(rd.maj23, rs.maj23)
+print("MESH RADIX13 PARITY OK")
+"""
+    env = dict(os.environ)
+    env["TXFLOW_FE_RADIX"] = "13"
+    parts = [
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p
+    ]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(parts + [repo])
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=repo,
+        env=env,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "MESH RADIX13 PARITY OK" in r.stdout

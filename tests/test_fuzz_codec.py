@@ -179,3 +179,67 @@ def test_consensus_wal_frame_arbitrary_bytes(raw):
         decode_wal_message(raw)
     except (ValueError, UnicodeDecodeError):
         return
+
+
+# ---------------------------------------------------------------- native
+# batch decoder parity: the C field locator must reproduce the Python
+# decoder EXACTLY — accept-set, field values, wire-cache decision.
+
+
+@given(st.binary(max_size=400))
+@settings(max_examples=400, deadline=None)
+def test_native_batch_decode_parity_fuzz(data):
+    from txflow_tpu import native
+    from txflow_tpu.types.tx_vote import decode_tx_vote, decode_tx_votes_many
+
+    if not native.available():
+        return
+    try:
+        expect = decode_tx_vote(data)
+        err = None
+    except ValueError:
+        expect, err = None, True
+    try:
+        got = decode_tx_votes_many([data])[0]
+        gerr = None
+    except ValueError:
+        got, gerr = None, True
+    assert bool(err) == bool(gerr), (data.hex(), err, gerr)
+    if expect is not None:
+        assert got.height == expect.height
+        assert got.tx_hash == expect.tx_hash
+        assert got.tx_key == expect.tx_key
+        assert got.timestamp_ns == expect.timestamp_ns
+        assert got.validator_address == expect.validator_address
+        assert got.signature == expect.signature
+        assert got._wire_cache == expect._wire_cache
+
+
+def test_native_batch_decode_roundtrip_real_votes():
+    import hashlib
+
+    from txflow_tpu.types import TxVote, encode_tx_vote
+    from txflow_tpu.types.priv_validator import MockPV
+    from txflow_tpu.types.tx_vote import decode_tx_votes_many
+    from txflow_tpu import native
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("no C compiler")
+    pv = MockPV()
+    segs, votes = [], []
+    for i in range(32):
+        key = hashlib.sha256(b"nd-%d" % i).digest()
+        v = TxVote(height=i % 3, tx_hash=key.hex().upper(), tx_key=key,
+                   validator_address=pv.get_address())
+        pv.sign_tx_vote("nd-chain", v)
+        votes.append(v)
+        segs.append(encode_tx_vote(v))
+    got = decode_tx_votes_many(segs)
+    for v, g, seg in zip(votes, got, segs):
+        assert (g.height, g.tx_hash, g.tx_key, g.timestamp_ns,
+                g.validator_address, g.signature) == (
+            v.height, v.tx_hash, v.tx_key, v.timestamp_ns,
+            v.validator_address, v.signature)
+        assert g._wire_cache == seg  # canonical: cache primed

@@ -7,10 +7,14 @@ separate blockchain fast-sync reactor v1 for lagging peers.
 
 Deviation (documented): the reference runs per-peer gossip routines that
 walk PeerState bitarrays (reactor.go:465-729); here nodes PUSH their own
-proposals/votes to all peers as they are produced, which is equivalent
-under the full-mesh topologies the framework deploys (validators
-interconnect over DCN; LocalNet mirrors that); catchup for late joiners
-rides the block request/response path.
+proposals/votes to all peers as they are produced, and periodic position
+announces carry current-round prevote/precommit BITMASKS + a
+has-proposal flag, kept per peer in PeerRoundState — the re-offer path
+then ships only deltas. This subsumes the reference's separate
+queryMaj23Routine/VoteSetBits exchange (reactor.go:729-780): those
+messages exist to learn which votes a peer lacks, which the announce
+bitmasks state directly. Catchup for late joiners rides the parallel
+block request/response pool.
 """
 
 from __future__ import annotations

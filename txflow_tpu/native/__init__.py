@@ -83,15 +83,32 @@ def _load():
         lib.txflow_sha512.argtypes = [u8p, ctypes.c_size_t, u8p]
         lib.txflow_sha512.restype = None
         i32p = ctypes.POINTER(ctypes.c_int32)
-        lib.txflow_sign_bytes_batch.argtypes = [
-            ctypes.c_int64,  # n_votes
-            i64p,  # heights
-            u8p, ctypes.c_int64, i32p,  # hashes, stride, lens
-            i64p,  # timestamps
-            u8p, ctypes.c_int32,  # chain, len
-            u8p, ctypes.c_int64, i32p,  # out, stride, lens
-        ]
-        lib.txflow_sign_bytes_batch.restype = None
+        # codec.c symbols are OPTIONAL (the .so may have been built
+        # without it): ctypes attribute access raises on a missing
+        # symbol, which would otherwise break available() entirely and
+        # make the hasattr fallbacks downstream unreachable (r5 review)
+        try:
+            lib.txflow_sign_bytes_batch.argtypes = [
+                ctypes.c_int64,  # n_votes
+                i64p,  # heights
+                u8p, ctypes.c_int64, i32p,  # hashes, stride, lens
+                i64p,  # timestamps
+                u8p, ctypes.c_int32,  # chain, len
+                u8p, ctypes.c_int64, i32p,  # out, stride, lens
+            ]
+            lib.txflow_sign_bytes_batch.restype = None
+            lib.txflow_decode_votes.argtypes = [
+                u8p, i64p, ctypes.c_int64,  # buf, offsets, n
+                i64p, i64p,  # heights, timestamps
+                i32p, i32p,  # hash off/len
+                i32p,  # key off
+                i32p, i32p,  # addr off/len
+                i32p, i32p,  # sig off/len
+                u8p,  # flags
+            ]
+            lib.txflow_decode_votes.restype = None
+        except AttributeError:
+            pass
         _lib = lib
         return _lib
 
@@ -200,3 +217,53 @@ def sign_bytes_batch(
         else None
         for i in range(n)
     ]
+
+
+def decode_votes_fields(segs: list[bytes]):
+    """Batch field-location pass for amino TxVote segments (codec.c).
+
+    Returns (heights, timestamps, hash_off, hash_len, key_off, addr_off,
+    addr_len, sig_off, sig_len, flags, concat) — offsets into ``concat``;
+    flags bit0 = parsed ok, bit1 = canonical wire, bit2 = exactness
+    corner needing the Python decoder. None when native is unavailable.
+    The caller (types.tx_vote.decode_tx_votes_many) slices fields and
+    builds the TxVote objects.
+    """
+    lib = _load()
+    if lib is None or not hasattr(lib, "txflow_decode_votes"):
+        return None
+    n = len(segs)
+    concat = b"".join(segs)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum([len(s) for s in segs], out=offsets[1:])
+    buf = (
+        np.frombuffer(concat, np.uint8)
+        if concat
+        else np.zeros(1, np.uint8)
+    )
+    heights = np.zeros(n, np.int64)
+    timestamps = np.zeros(n, np.int64)
+    i32 = lambda: np.zeros(n, np.int32)  # noqa: E731
+    hash_off, hash_len = i32(), i32()
+    key_off = i32()
+    addr_off, addr_len = i32(), i32()
+    sig_off, sig_len = i32(), i32()
+    flags = np.zeros(n, np.uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.txflow_decode_votes(
+        _u8p(buf),
+        offsets.ctypes.data_as(i64p),
+        n,
+        heights.ctypes.data_as(i64p),
+        timestamps.ctypes.data_as(i64p),
+        hash_off.ctypes.data_as(i32p), hash_len.ctypes.data_as(i32p),
+        key_off.ctypes.data_as(i32p),
+        addr_off.ctypes.data_as(i32p), addr_len.ctypes.data_as(i32p),
+        sig_off.ctypes.data_as(i32p), sig_len.ctypes.data_as(i32p),
+        _u8p(flags),
+    )
+    return (
+        heights, timestamps, hash_off, hash_len, key_off,
+        addr_off, addr_len, sig_off, sig_len, flags, concat,
+    )

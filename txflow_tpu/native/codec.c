@@ -31,6 +31,11 @@
 #include <stdint.h>
 #include <string.h>
 
+#ifndef INT64_MAX
+#define INT64_MAX 0x7fffffffffffffffLL
+#define INT64_MIN (-INT64_MAX - 1)
+#endif
+
 static inline size_t put_uvarint(uint8_t *out, uint64_t n) {
     size_t i = 0;
     while (n > 0x7F) {
@@ -137,5 +142,194 @@ void txflow_sign_bytes_batch(
             hashes + i * hash_stride, hash_lens[i],
             timestamps[i],
             chain, chain_len);
+    }
+}
+
+/* ------------------------------------------------------------------ */
+/* Batch TxVote wire decode                                            */
+/* ------------------------------------------------------------------ */
+/* Field LOCATOR for the amino TxVote wire form: mirrors the accept-set
+ * of types/tx_vote.py decode_tx_vote EXACTLY (pinned by the fuzz parity
+ * test in tests/test_fuzz_codec.py). C locates the fields and computes
+ * the canonical flag; Python slices the bytes and builds the TxVote
+ * (including the strict UTF-8 validation of tx_hash, which happens for
+ * free in the str construction Python must do anyway). Decode measured
+ * ~6 us/vote in Python — once per unique gossiped vote per process. */
+
+/* uvarint with Go binary.Uvarint overflow rules.
+ * Returns bytes consumed (>0), 0 on error. *minimal = last group != 0. */
+static size_t get_uvarint(
+    const uint8_t *p, size_t avail, uint64_t *out, int *minimal) {
+    uint64_t n = 0;
+    int shift = 0;
+    size_t i = 0;
+    for (;;) {
+        if (i >= avail) return 0;                /* truncated */
+        uint8_t b = p[i++];
+        if (shift == 63 && b > 1) return 0;      /* overflows 64 bits */
+        n |= (uint64_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) {
+            *out = n;
+            *minimal = (b != 0);
+            return i;
+        }
+        shift += 7;
+        if (shift > 63) return 0;
+    }
+}
+
+/* time.Time body: (seconds*1e9 + nanos, canonical). 0 ok, -1 error. */
+static int decode_ts_body(
+    const uint8_t *p, size_t len, int64_t *ts_out, int *canon_out) {
+    size_t pos = 0;
+    int64_t seconds = 0;
+    uint64_t nanos = 0;
+    int canonical = 1;
+    uint64_t prev = 0;
+    if (len == 0) { *ts_out = 0; *canon_out = 0; return 0; }
+    while (pos < len) {
+        uint64_t key; int mini;
+        size_t c = get_uvarint(p + pos, len - pos, &key, &mini);
+        if (!c) return -1;
+        pos += c;
+        if (!mini) canonical = 0;
+        uint64_t fnum = key >> 3;
+        uint64_t typ3 = key & 7;
+        if (fnum <= prev) canonical = 0;
+        prev = fnum;
+        if (typ3 == 0) {
+            uint64_t v;
+            c = get_uvarint(p + pos, len - pos, &v, &mini);
+            if (!c) return -1;
+            pos += c;
+            if (!mini) canonical = 0;
+            if (fnum == 1) {
+                seconds = (int64_t)v;  /* two's complement, like Python */
+                if (seconds == 0) canonical = 0;
+            } else if (fnum == 2) {
+                nanos = v;
+                if (!(v > 0 && v < 1000000000ULL)) canonical = 0;
+            } else {
+                canonical = 0;
+            }
+        } else if (typ3 == 1) {
+            if (pos + 8 > len) return -1;
+            pos += 8;
+            canonical = 0;
+        } else if (typ3 == 2) {
+            uint64_t ln;
+            c = get_uvarint(p + pos, len - pos, &ln, &mini);
+            if (!c) return -1;
+            pos += c;
+            if (!mini) canonical = 0;
+            if (ln > len || pos + ln > len) return -1;
+            pos += ln;
+            canonical = 0;
+        } else {
+            return -1;
+        }
+    }
+    /* seconds * 1e9 + nanos with Python bigint semantics: compute the
+     * exact sum in 128-bit and fall back to the Python decoder whenever
+     * it does not fit int64 (hostile seconds OR nanos — r5 review
+     * reproduced a silent divergence when only seconds was guarded:
+     * compiler-equipped and compiler-less nodes would disagree on the
+     * same wire bytes). Real votes are nowhere near these bounds. */
+    {
+        __int128 total = (__int128)seconds * 1000000000LL + (__int128)nanos;
+        if (total > (__int128)INT64_MAX || total < (__int128)INT64_MIN)
+            return -2; /* caller: python fallback */
+        *ts_out = (int64_t)total;
+    }
+    *canon_out = canonical;
+    return 0;
+}
+
+/* flags: bit0 = parsed ok; bit1 = canonical; bit2 = needs python
+ * fallback (rare exactness corner).  Offsets are GLOBAL into buf;
+ * *_off = -1 means absent. */
+void txflow_decode_votes(
+    const uint8_t *buf, const int64_t *offsets, int64_t n,
+    int64_t *heights, int64_t *timestamps,
+    int32_t *hash_off, int32_t *hash_len,
+    int32_t *key_off,
+    int32_t *addr_off, int32_t *addr_len,
+    int32_t *sig_off, int32_t *sig_len,
+    uint8_t *flags) {
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t *p = buf + offsets[i];
+        size_t end = (size_t)(offsets[i + 1] - offsets[i]);
+        size_t pos = 0;
+        int canonical = 1, ok = 1, py_fallback = 0;
+        uint64_t prev_fnum = 0;
+        heights[i] = 0;
+        timestamps[i] = 0;
+        hash_off[i] = -1; hash_len[i] = 0;
+        key_off[i] = -1;
+        addr_off[i] = -1; addr_len[i] = 0;
+        sig_off[i] = -1; sig_len[i] = 0;
+        while (pos < end) {
+            uint64_t key; int mini;
+            size_t c = get_uvarint(p + pos, end - pos, &key, &mini);
+            if (!c) { ok = 0; break; }
+            pos += c;
+            if (!mini) canonical = 0;
+            uint64_t fnum = key >> 3;
+            uint64_t typ3 = key & 7;
+            if (fnum <= prev_fnum) canonical = 0;
+            prev_fnum = fnum;
+            if (typ3 == 2) {
+                uint64_t ln;
+                c = get_uvarint(p + pos, end - pos, &ln, &mini);
+                if (!c) { ok = 0; break; }
+                pos += c;
+                if (!mini) canonical = 0;
+                if (ln > end || pos + ln > end) { ok = 0; break; }
+                int32_t off = (int32_t)(offsets[i] + (int64_t)pos);
+                if (fnum == 2) {
+                    hash_off[i] = off; hash_len[i] = (int32_t)ln;
+                    if (ln == 0) canonical = 0;
+                } else if (fnum == 3) {
+                    if (ln != 32) { ok = 0; break; }  /* Go array error */
+                    key_off[i] = off;
+                } else if (fnum == 4) {
+                    int canon2;
+                    int r = decode_ts_body(p + pos, ln, &timestamps[i], &canon2);
+                    if (r == -1) { ok = 0; break; }
+                    if (r == -2) { py_fallback = 1; break; }
+                    if (!canon2) canonical = 0;
+                } else if (fnum == 5) {
+                    addr_off[i] = off; addr_len[i] = (int32_t)ln;
+                    if (ln == 0) canonical = 0;
+                } else if (fnum == 6) {
+                    sig_off[i] = off; sig_len[i] = (int32_t)ln;
+                    if (ln == 0) canonical = 0;
+                } else {
+                    canonical = 0;  /* unknown BYTELEN: skipped */
+                }
+                pos += ln;
+            } else if (typ3 == 0) {
+                uint64_t v;
+                c = get_uvarint(p + pos, end - pos, &v, &mini);
+                if (!c) { ok = 0; break; }
+                pos += c;
+                if (!mini) canonical = 0;
+                if (fnum == 1) {
+                    heights[i] = (int64_t)v;  /* two's complement */
+                    if (heights[i] == 0) canonical = 0;
+                } else {
+                    canonical = 0;  /* unknown varint: skipped */
+                }
+            } else if (typ3 == 1) {
+                if (pos + 8 > end) { ok = 0; break; }
+                pos += 8;
+                canonical = 0;
+            } else {
+                ok = 0;
+                break;
+            }
+        }
+        flags[i] = (uint8_t)((ok ? 1 : 0) | (canonical ? 2 : 0) |
+                             (py_fallback ? 4 : 0));
     }
 }

@@ -31,6 +31,7 @@ from ..pool.mempool import ErrMempoolIsFull, ErrTxInCache, ErrTxTooLarge, Mempoo
 from ..pool.txvotepool import TxVotePool
 from ..crypto.hash import sha256
 from ..types import TxVote, decode_tx_vote, encode_tx_vote
+from ..types.tx_vote import decode_tx_votes_many
 from ..utils.cache import LRUMap
 from ..types.priv_validator import PrivValidator
 from ..types.validator import ValidatorSet
@@ -173,6 +174,7 @@ class TxVoteReactor(Reactor):
             seen = self._seen_wire
             tx_info = TxInfo(sender_id=pid)
             ingest: list = []  # (wk, vote) needing the authoritative path
+            fresh_segs: list[bytes] = []  # wire-cache misses: batch decode
             while not r.eof():
                 seg = r.read_bytes()  # decode error -> peer stopped
                 # raw seg bytes ARE the cache key: siphash of ~150 B costs
@@ -198,9 +200,17 @@ class TxVoteReactor(Reactor):
                         # gone, so there is no sender set to update) —
                         # skip the authoritative round trip entirely
                         continue
+                    ingest.append((wk, vote))
                 else:
-                    vote = decode_tx_vote(seg)
-                ingest.append((wk, vote))
+                    fresh_segs.append(seg)
+            if fresh_segs:
+                # one C field-walk for the whole frame's unknown segs
+                # (decode error -> ValueError -> peer stopped, same as
+                # the per-seg decoder)
+                for seg, vote in zip(
+                    fresh_segs, decode_tx_votes_many(fresh_segs)
+                ):
+                    ingest.append((seg, vote))
             if ingest:
                 # one pool lock for the whole frame (check_tx_many);
                 # full/too-large rejections drop the vote like the

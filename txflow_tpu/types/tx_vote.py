@@ -382,6 +382,64 @@ def decode_tx_vote(data: bytes) -> TxVote:
     return vote
 
 
+def decode_tx_votes_many(segs: list[bytes]) -> list[TxVote]:
+    """Batch decode of gossiped vote segments; raises ValueError on the
+    FIRST undecodable segment (same contract as per-seg decode_tx_vote —
+    the receive path stops the peer).
+
+    The amino field walk runs in one C call (native/codec.c, a strict
+    accept-set mirror of decode_tx_vote, fuzz-pinned by
+    tests/test_fuzz_codec.py); Python slices the located fields and
+    constructs the TxVote objects — including the strict UTF-8 check of
+    tx_hash, which str() performs anyway. Exactness corners the C side
+    flags (bit2: timestamps beyond int64) and builds missing native
+    support fall back to the Python decoder, identical results.
+    """
+    from .. import native
+
+    fields = native.decode_votes_fields(segs)
+    if fields is None:
+        return [decode_tx_vote(s) for s in segs]
+    (
+        heights, timestamps, hash_off, hash_len, key_off,
+        addr_off, addr_len, sig_off, sig_len, flags, concat,
+    ) = fields
+    out: list[TxVote] = []
+    oset = object.__setattr__
+    for i, seg in enumerate(segs):
+        f = flags[i]
+        if not f & 1:
+            raise ValueError("undecodable tx vote segment")
+        if f & 4:  # exactness corner: defer to the Python decoder
+            out.append(decode_tx_vote(seg))
+            continue
+        ho = hash_off[i]
+        tx_hash = (
+            concat[ho : ho + hash_len[i]].decode() if ho >= 0 else ""
+        )  # strict utf-8: raises like decode_tx_vote (stops the peer)
+        ko = key_off[i]
+        tx_key = concat[ko : ko + 32] if ko >= 0 else _ZERO_TXKEY
+        ao = addr_off[i]
+        addr = concat[ao : ao + addr_len[i]] if ao >= 0 else b""
+        so = sig_off[i]
+        sig = concat[so : so + sig_len[i]] if so >= 0 else None
+        vote = TxVote.__new__(TxVote)
+        oset(vote, "height", int(heights[i]))
+        oset(vote, "tx_hash", tx_hash)
+        oset(vote, "tx_key", tx_key)
+        oset(vote, "timestamp_ns", int(timestamps[i]))
+        oset(vote, "validator_address", addr)
+        oset(vote, "signature", sig)
+        oset(vote, "_sb_cache", None)
+        oset(vote, "_vk_cache", None)
+        if sig and (f & 2) and ko >= 0:
+            oset(vote, "_wire_cache", seg)
+        else:
+            oset(vote, "_wire_cache", None)
+        out.append(vote)
+    return out
+
+
 def _decode_ts_body(body: bytes) -> tuple[int, bool]:
     """(unix_ns, canonical): canonical iff body == encode_time_body(ns)."""
     if not body:
