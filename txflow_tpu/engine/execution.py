@@ -33,6 +33,10 @@ class TxExecutor:
         self.metrics = metrics or TxFlowMetrics()
         self._ev_thread = None  # lazy event worker (see _fire_events)
         self._ev_q = None
+        # enqueue/publish accounting so events_drained() can say when
+        # every queued commit event has actually reached the bus
+        self._ev_enqueued = 0
+        self._ev_published = 0
 
     def set_event_bus(self, bus: EventBus) -> None:
         self.event_bus = bus
@@ -157,6 +161,7 @@ class TxExecutor:
                 target=self._event_worker, name="txflow-events", daemon=True
             )
             self._ev_thread.start()
+        self._ev_enqueued += 1
         self._ev_q.put((height, tx, deliver_res, tx_hash))
 
     def _event_worker(self) -> None:
@@ -186,6 +191,13 @@ class TxExecutor:
                 import traceback
 
                 traceback.print_exc()
+            finally:
+                self._ev_published += 1
+
+    def events_drained(self) -> bool:
+        """True when every queued commit event has been published to the
+        bus (subscribers' own queues are theirs to drain)."""
+        return self._ev_published >= self._ev_enqueued
 
     def drain_events(self, timeout: float = 5.0) -> None:
         """Flush queued commit events and stop the worker (clean-shutdown

@@ -496,8 +496,13 @@ class TxFlow:
         committer's queue is empty AND its in-flight wake finished).
         Decision-time facts (certificates, is_tx_committed) lead the ABCI
         app state by the pipeline depth; tests/operators comparing app
-        hashes across nodes must wait for this."""
-        return self._applied_count >= self._decided_count
+        hashes across nodes must wait for this. Also covers the event
+        worker: a drained engine has PUBLISHED every commit event (each
+        subscriber's own queue is its own concern)."""
+        return (
+            self._applied_count >= self._decided_count
+            and self.tx_executor.events_drained()
+        )
 
     def is_tx_committed(self, tx_hash: str) -> bool:
         """Committed via EITHER path: the fast path (TxStore certificate)

@@ -30,7 +30,7 @@ from ..p2p.base import CHANNEL_TXVOTE, ChannelDescriptor, Reactor
 from ..pool.mempool import ErrMempoolIsFull, ErrTxInCache, ErrTxTooLarge, Mempool, TxInfo
 from ..pool.txvotepool import TxVotePool
 from ..crypto.hash import sha256
-from ..types import TxVote, decode_tx_vote, encode_tx_vote
+from ..types import TxVote, encode_tx_vote
 from ..types.tx_vote import decode_tx_votes_many
 from ..utils.cache import LRUMap
 from ..types.priv_validator import PrivValidator
@@ -175,6 +175,7 @@ class TxVoteReactor(Reactor):
             tx_info = TxInfo(sender_id=pid)
             ingest: list = []  # (wk, vote) needing the authoritative path
             fresh_segs: list[bytes] = []  # wire-cache misses: batch decode
+            fresh_slots: list[int] = []  # their ingest positions
             while not r.eof():
                 seg = r.read_bytes()  # decode error -> peer stopped
                 # raw seg bytes ARE the cache key: siphash of ~150 B costs
@@ -202,15 +203,20 @@ class TxVoteReactor(Reactor):
                         continue
                     ingest.append((wk, vote))
                 else:
+                    # placeholder keeps WIRE order: acceptance at the
+                    # pool-full boundary must see votes in arrival order,
+                    # not hits-then-misses (r5 review)
+                    fresh_slots.append(len(ingest))
+                    ingest.append((wk, None))
                     fresh_segs.append(seg)
             if fresh_segs:
                 # one C field-walk for the whole frame's unknown segs
                 # (decode error -> ValueError -> peer stopped, same as
                 # the per-seg decoder)
-                for seg, vote in zip(
-                    fresh_segs, decode_tx_votes_many(fresh_segs)
+                for slot, vote in zip(
+                    fresh_slots, decode_tx_votes_many(fresh_segs)
                 ):
-                    ingest.append((seg, vote))
+                    ingest[slot] = (ingest[slot][0], vote)
             if ingest:
                 # one pool lock for the whole frame (check_tx_many);
                 # full/too-large rejections drop the vote like the
