@@ -263,3 +263,58 @@ def test_group_commit_matches_per_tx_commit():
         finally:
             net.stop()
     assert results[1] == results[4], results
+
+
+def test_quorum_before_tx_defers_apply_until_bytes_arrive():
+    """A vote quorum can land (gossip) before the tx bytes reach the
+    local mempool. The certificate must persist immediately, but the
+    ABCI apply must DEFER until the bytes arrive — not be silently
+    skipped (r5 soak: post-partition churn left a node with the
+    certificate, no apply, and claim_vtx blocking the block path's
+    delivery too — permanent state divergence)."""
+    import hashlib as _h
+    import time as _t
+
+    from txflow_tpu.node import LocalNet
+
+    # mempool gossip OFF: tx bytes only exist where we put them
+    net = LocalNet(4, use_device_verifier=False, mempool_broadcast=False)
+    net.start()
+    try:
+        tx = b"late-bytes=v"
+        tx_hash = _h.sha256(tx).hexdigest().upper()
+        # nodes 1-3 get the tx (and their signers vote); node 0 does NOT
+        for node in net.nodes[1:]:
+            node.mempool.check_tx(tx)
+        deadline = _t.monotonic() + 30
+        while _t.monotonic() < deadline:
+            if all(n.tx_store.has_tx(tx_hash) for n in net.nodes):
+                break
+            _t.sleep(0.02)
+        # every node holds the certificate (3/4 quorum formed via gossip)
+        for n in net.nodes:
+            assert n.tx_store.has_tx(tx_hash), "certificate missing"
+        # nodes 1-3 applied; node 0 must have DEFERRED, not dropped
+        deadline = _t.monotonic() + 10
+        while _t.monotonic() < deadline:
+            if all(n.app.state.get(b"late-bytes") == b"v" for n in net.nodes[1:]):
+                break
+            _t.sleep(0.02)
+        for n in net.nodes[1:]:
+            assert n.app.state.get(b"late-bytes") == b"v"
+        assert net.nodes[0].app.state.get(b"late-bytes") is None
+        assert tx_hash in net.nodes[0].txflow._unapplied
+
+        # the bytes arrive late: the committer retry applies them
+        net.nodes[0].mempool.check_tx(tx)
+        deadline = _t.monotonic() + 15
+        while _t.monotonic() < deadline:
+            if net.nodes[0].app.state.get(b"late-bytes") == b"v":
+                break
+            _t.sleep(0.02)
+        assert net.nodes[0].app.state.get(b"late-bytes") == b"v", (
+            "deferred apply never ran after the bytes arrived"
+        )
+        assert tx_hash not in net.nodes[0].txflow._unapplied
+    finally:
+        net.stop()
