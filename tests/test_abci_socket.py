@@ -258,3 +258,27 @@ def test_async_callback_fires_at_fence_without_forcing_flush():
         conns.close()
     finally:
         srv.stop()
+
+
+def test_check_tx_fast_path_flag_crosses_the_socket():
+    """An out-of-process app's block-only verdict (fast_path=False) must
+    survive the wire round trip — losing it would let validators fast-
+    sign EndBlock-coupled txs (wire.py uv(block_only) field)."""
+
+    class Flagger(KVStoreApplication):
+        def check_tx(self, tx: bytes) -> ResponseCheckTx:
+            if tx.startswith(b"block-only:"):
+                return ResponseCheckTx(gas_wanted=7, fast_path=False)
+            return ResponseCheckTx(gas_wanted=1)
+
+    srv = ABCIServer(Flagger())
+    srv.start()
+    try:
+        conns = RemoteAppConns(f"{srv.addr[0]}:{srv.addr[1]}")
+        r1 = conns.mempool.check_tx_sync(b"block-only:val")
+        assert r1.fast_path is False and r1.gas_wanted == 7
+        r2 = conns.mempool.check_tx_sync(b"normal=1")
+        assert r2.fast_path is True and r2.gas_wanted == 1
+        conns.close()
+    finally:
+        srv.stop()

@@ -764,3 +764,56 @@ def test_parallel_sync_50_blocks():
         print(f"parallel sync: {synced} blocks in {parallel_t:.2f}s ({rate:.0f} blocks/s)")
     finally:
         net.stop()
+
+
+def test_validator_rotation_with_fast_path_on():
+    """A val: tx must rotate the set even with the fast path RUNNING:
+    the app flags it block-only via ResponseCheckTx.fast_path=False,
+    honest validators refuse to sign it (no fast quorum can form), the
+    block carries it as a Tx, and EndBlock applies the update at H+2.
+    Ordinary txs keep fast-committing alongside (r5 soak follow-up: a
+    fast-committed val: tx silently never rotated — BeginBlock clears
+    the app's pending updates)."""
+    cfg = make_test_config()
+    cfg.consensus.skip_timeout_commit = True
+    net = LocalNet(4, use_device_verifier=False, enable_consensus=True, config=cfg)
+    net.start()
+    try:
+        # ordinary tx fast-commits
+        net.broadcast_tx(b"fastok=1")
+        assert net.wait_all_committed([b"fastok=1"], timeout=30)
+
+        new_pv = MockPV(hashlib.sha256(b"rotate-live").digest())
+        new_pub = new_pv.get_pub_key()
+        tx = b"val:" + new_pub.hex().encode() + b"!5"
+        net.broadcast_tx(tx)
+        tx_hash = hashlib.sha256(tx).hexdigest().upper()
+
+        def rotated():
+            return all(
+                n.consensus.state.validators.has_address(
+                    Validator.from_pub_key(new_pub, 5).address
+                )
+                for n in net.nodes
+            )
+
+        assert wait_until(rotated, timeout=90), (
+            "validator set must rotate with the fast path on"
+        )
+        # the val: tx must NOT have fast-committed (no certificate)
+        for n in net.nodes:
+            assert n.tx_store.load_tx_commit(tx_hash) is None, (
+                "block-only tx was fast-committed"
+            )
+        # it traveled in a block's Txs
+        store = net.nodes[0].block_store
+        in_block = any(
+            tx in store.load_block(h).txs
+            for h in range(1, store.height() + 1)
+        )
+        assert in_block, "val: tx never entered a block"
+        # fast path still healthy afterwards
+        net.broadcast_tx(b"fastok=2")
+        assert net.wait_all_committed([b"fastok=2"], timeout=30)
+    finally:
+        net.stop()

@@ -5,7 +5,7 @@ Dev tool (not part of the test suite — wall-clock minutes): exercises the
 full stack the way a flaky validator set would — fast path + block
 ticker, hostile votes (bad sig, unknown validator, oversized fields),
 repeated partitions and heals — then checks for forks, stalls, and leaks.
-Usage: JAX_PLATFORMS=cpu python tools/soak.py [seconds]
+Usage: JAX_PLATFORMS=cpu python tools/soak.py [seconds] [--rotate]
 """
 
 import os
@@ -29,7 +29,8 @@ def main() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    duration = float(sys.argv[1]) if len(sys.argv) > 1 else 120.0
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    duration = float(args[0]) if args else 120.0
     rng = random.Random(1234)
     cfg = test_config()
     cfg.consensus.skip_timeout_commit = True
@@ -74,6 +75,20 @@ def main() -> None:
                 node.tx_vote_pool.check_tx(v)
             except Exception:
                 pass
+            # 2b) validator rotation churn (--rotate): flip one
+            # validator's power via a val: tx (kvstore -> EndBlock ->
+            # engine epoch rotation at H+2) while the vote flood runs
+            if "--rotate" in sys.argv and phase % 25 == 10:
+                vi = rng.randrange(4)
+                pub = net.priv_vals[vi].get_pub_key().hex()
+                power = 10 + (phase // 25) % 3  # 10 <-> 11 <-> 12
+                try:
+                    net.broadcast_tx(
+                        b"val:%s!%d" % (pub.encode(), power),
+                        node_index=rng.randrange(4),
+                    )
+                except Exception:
+                    pass
             # 3) partition / heal churn (~every 8 phases): drop the link
             # between one random pair, later reconnect it
             if cut is None and phase % 8 == 3:

@@ -57,6 +57,8 @@ class KVStoreApplication(Application):
             err = self._parse_val_tx(tx)[0]
             if err:
                 return ResponseCheckTx(code=1, log=err)
+            # validator updates apply via EndBlock: block-only
+            return ResponseCheckTx(gas_wanted=1, fast_path=False)
         return ResponseCheckTx(gas_wanted=1)
 
     # -- consensus --

@@ -18,6 +18,13 @@ class ResponseCheckTx:
     data: bytes = b""
     log: str = ""
     gas_wanted: int = 0
+    # fast-path eligibility: False = this tx must commit through a BLOCK
+    # (EndBlock-coupled semantics like validator updates cannot flow
+    # through per-tx fast commits — BeginBlock clears pending updates, so
+    # a fast-committed val: tx would silently never rotate the set).
+    # Honest validators simply do not sign ineligible txs; without their
+    # signatures no 2/3 quorum can form, so the block path carries them.
+    fast_path: bool = True
 
     @property
     def is_ok(self) -> bool:

@@ -19,7 +19,7 @@ appears in responses):
 | 2    | FLUSH: empty                         | empty            |
 | 3    | INFO: empty                          | lp(data) lp(version) uv(height) lp(app_hash) |
 | 4    | INIT_CHAIN: uv(n) [lp(pub) uv(pow)]* | empty            |
-| 5    | CHECK_TX: tx                         | uv(code) lp(data) lp(log) uv(gas) |
+| 5    | CHECK_TX: tx                         | uv(code) lp(data) lp(log) uv(gas) uv(block_only) |
 | 6    | BEGIN_BLOCK: lp(hash) uv(height) lp(proposer) uv(n) [lp(addr) uv(h)]* | empty |
 | 7    | DELIVER_TX: tx                       | uv(code) lp(data) lp(log) uv(n) [lp(k) lp(v)]* |
 | 8    | END_BLOCK: uv(height)                | uv(n) [lp(pub) uv(pow)]* |
@@ -189,6 +189,7 @@ def encode_response(kind: int, res) -> bytes:
             + length_prefixed(res.data or b"")
             + length_prefixed(res.log.encode())
             + uvarint(res.gas_wanted)
+            + uvarint(0 if getattr(res, "fast_path", True) else 1)
         )
     if kind == DELIVER_TX:
         out = bytearray([kind])
@@ -246,9 +247,15 @@ def decode_response(payload: bytes):
         code, off = read_uvarint(body, 0)
         data, off = _lp_read(body, off)
         log, off = _lp_read(body, off)
-        gas, _ = read_uvarint(body, off)
+        gas, off = read_uvarint(body, off)
+        # block-only flag (0 = fast-path eligible); absent in frames from
+        # older servers -> default eligible
+        block_only = 0
+        if off < len(body):
+            block_only, _ = read_uvarint(body, off)
         return kind, ResponseCheckTx(
-            code=code, data=data, log=log.decode(), gas_wanted=gas
+            code=code, data=data, log=log.decode(), gas_wanted=gas,
+            fast_path=(block_only == 0),
         )
     if kind == DELIVER_TX:
         code, off = read_uvarint(body, 0)

@@ -61,6 +61,7 @@ class _MempoolTx:
     gas_wanted: int
     tx: bytes
     senders: set[int] = field(default_factory=set)
+    fast_path: bool = True  # app CheckTx verdict (ResponseCheckTx.fast_path)
 
 
 class Mempool(IngestLogPool):
@@ -142,12 +143,14 @@ class Mempool(IngestLogPool):
                 if err is not None:
                     self.cache.remove(key)
                     raise ValueError(f"rejected by pre_check: {err}")
+            fast_path = True
             if self.proxy_app is not None:
                 res = self.proxy_app.check_tx_sync(tx)
                 if not res.is_ok:
                     self.cache.remove(key)
                     raise ValueError(f"rejected by app CheckTx (code {res.code}): {res.log}")
                 gas = res.gas_wanted
+                fast_path = getattr(res, "fast_path", True)
             else:
                 gas = 0
             if self.post_check is not None:
@@ -157,7 +160,9 @@ class Mempool(IngestLogPool):
                     raise ValueError(f"rejected by post_check: {err}")
             if self.wal is not None and write_wal:
                 self.wal.write(tx)
-            entry = _MempoolTx(self.height, gas, tx, {tx_info.sender_id})
+            entry = _MempoolTx(
+                self.height, gas, tx, {tx_info.sender_id}, fast_path
+            )
             self._txs[key] = entry
             self._log_append(key)
             self._txs_bytes += len(tx)
@@ -216,11 +221,12 @@ class Mempool(IngestLogPool):
 
     def entries_from(
         self, cursor: int, limit: int = 256
-    ) -> tuple[list[tuple[bytes, bytes, int]], int]:
-        """Stable-cursor walk of live txs: (tx_key, tx, height) triples;
-        see IngestLogPool._entries_from for the cursor contract."""
+    ) -> tuple[list[tuple[bytes, bytes, int, bool]], int]:
+        """Stable-cursor walk of live txs: (tx_key, tx, height,
+        fast_path) tuples; see IngestLogPool._entries_from for the
+        cursor contract."""
         raw, pos = self._entries_from(cursor, limit)
-        return [(k, e.tx, e.height) for k, e in raw], pos
+        return [(k, e.tx, e.height, e.fast_path) for k, e in raw], pos
 
     # -- update on commit (reference :358-422) --
 

@@ -124,7 +124,7 @@ class MempoolReactor(Reactor):
     def _broadcast_routine(self, peer) -> None:
         pid = self._peer_id(peer)
         cursor = 0
-        pending: list[tuple[bytes, bytes, int]] = []
+        pending: list[tuple[bytes, bytes, int, bool]] = []
         seq = self.mempool.seq()
         while self._running.is_set() and peer.is_running():
             if not pending:
@@ -136,9 +136,9 @@ class MempoolReactor(Reactor):
                 continue
             peer_height = peer.get(PEER_HEIGHT_KEY, 0)
             sendable, deferred = [], []
-            for key, tx, h in pending:
+            for key, tx, h, _fp in pending:
                 if h - 1 > peer_height:  # allow a lag of 1 block (:236-239)
-                    deferred.append((key, tx, h))
+                    deferred.append((key, tx, h, _fp))
                 elif not self.mempool.has_sender(key, pid):
                     sendable.append(tx)
             if sendable:

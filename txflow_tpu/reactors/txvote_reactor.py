@@ -256,8 +256,14 @@ class TxVoteReactor(Reactor):
             my_addr = self.priv_val.get_address()
             if not st.validators.has_address(my_addr):
                 continue  # keep running: could become a validator any round
-            for _key, tx, _h in items:
-                tx_key = sha256(tx)
+            for tx_key, tx, _h, fast_path in items:
+                if not fast_path:
+                    # app flagged this tx block-only (e.g. EndBlock-
+                    # coupled validator updates): honest validators do
+                    # not sign it, so no fast-path quorum can form and
+                    # the block path carries it
+                    continue
+                # the mempool key IS sha256(tx) — no recompute
                 vote = TxVote(
                     height=st.last_block_height,
                     tx_hash=tx_key.hex().upper(),
