@@ -817,3 +817,105 @@ def test_validator_rotation_with_fast_path_on():
         assert net.wait_all_committed([b"fastok=2"], timeout=30)
     finally:
         net.stop()
+
+
+def test_malformed_announce_stops_peer_not_node():
+    """Hostile ROUND_STEP announces (wrong types, oversized vote masks)
+    must be contained: the reactor raises, the switch stops THAT peer,
+    and the node keeps serving honest peers."""
+    import json as _json
+
+    from txflow_tpu.consensus.reactor import MSG_ROUND_STEP
+
+    cfg = make_test_config()
+    net = LocalNet(1, use_device_verifier=False, enable_consensus=True, config=cfg)
+    node = net.nodes[0]  # constructed, not started: direct receive calls
+    reactor = node.consensus_reactor
+
+    class FakePeer:
+        node_id = "hostile"
+
+        def __init__(self):
+            self.kv = {}
+
+        def set(self, k, v):
+            self.kv[k] = v
+
+        def get(self, k, default=None):
+            return self.kv.get(k, default)
+
+        def try_send(self, chan, msg):
+            return True
+
+    hostile_bodies = [
+        {"height": "not-an-int", "committed": 0},
+        {"height": 1, "committed": 0, "prevotes": "zz"},  # bad hex
+        {"height": 1, "committed": 0, "prevotes": "f" * 100000},  # huge mask
+        {"height": 1},  # missing committed
+    ]
+    for body in hostile_bodies:
+        try:
+            reactor.receive(
+                0x20, FakePeer(),
+                bytes([MSG_ROUND_STEP]) + _json.dumps(body).encode(),
+            )
+            raised = False
+        except Exception:
+            raised = True  # the switch converts this into stop_peer
+        assert raised, f"hostile announce accepted silently: {body}"
+    # the reactor still serves a WELL-FORMED announce afterwards
+    good = FakePeer()
+    reactor.receive(
+        0x20, good,
+        bytes([MSG_ROUND_STEP])
+        + _json.dumps(node.consensus.round_summary()).encode(),
+    )
+    assert good.get("consensus_height") is not None
+
+
+def test_byzantine_vote_cannot_censor_block_only_tx():
+    """One stray signed vote for a block-only tx must NOT wedge it: an
+    in-flight vote set that can never reach quorum (honest validators
+    refuse to sign fast_path=False txs) does not reserve the tx, so
+    proposers still carry it in blocks and the rotation completes (r5
+    review: is_tx_reserved treated any vote set as a permanent claim)."""
+    cfg = make_test_config()
+    cfg.consensus.skip_timeout_commit = True
+    net = LocalNet(4, use_device_verifier=False, enable_consensus=True, config=cfg)
+    net.start()
+    try:
+        new_pv = MockPV(hashlib.sha256(b"censor-target").digest())
+        tx = b"val:" + new_pv.get_pub_key().hex().encode() + b"!5"
+        net.broadcast_tx(tx)
+        # a BYZANTINE validator signs the block-only tx (honest ones
+        # won't): inject its vote into every node's pool
+        tx_key = hashlib.sha256(tx).digest()
+        byz = net.priv_vals[0]
+        for node in net.nodes:
+            from txflow_tpu.types import TxVote
+
+            v = TxVote(
+                height=0,
+                tx_hash=tx_key.hex().upper(),
+                tx_key=tx_key,
+                validator_address=byz.get_address(),
+            )
+            byz.sign_tx_vote(node.chain_id, v)
+            try:
+                node.tx_vote_pool.check_tx(v)
+            except Exception:
+                pass
+
+        def rotated():
+            return all(
+                n.consensus.state.validators.has_address(
+                    Validator.from_pub_key(new_pv.get_pub_key(), 5).address
+                )
+                for n in net.nodes
+            )
+
+        assert wait_until(rotated, timeout=90), (
+            "one byzantine vote censored the block-only tx"
+        )
+    finally:
+        net.stop()

@@ -81,7 +81,11 @@ def main() -> None:
             if "--rotate" in sys.argv and phase % 25 == 10:
                 vi = rng.randrange(4)
                 pub = net.priv_vals[vi].get_pub_key().hex()
-                power = 10 + (phase // 25) % 3  # 10 <-> 11 <-> 12
+                # monotone power => every rotation tx is UNIQUE (a
+                # repeated (vi, power) pair would sit in the mempool
+                # dedup cache and the churn would silently degrade to
+                # no-ops — r5 review)
+                power = 10 + phase // 25
                 try:
                     net.broadcast_tx(
                         b"val:%s!%d" % (pub.encode(), power),

@@ -218,6 +218,17 @@ class ConsensusReactor(Reactor):
         kind, body = msg[0], msg[1:]
         if kind == MSG_ROUND_STEP:
             d = json.loads(body)
+            # type-validate before ANY field reaches peer state: a str
+            # height would sit poisoned in PeerRoundState (json carries
+            # no schema; undecodable -> the switch stops the peer)
+            if not isinstance(d.get("height"), int) or not isinstance(
+                d.get("committed"), int
+            ):
+                raise ValueError("malformed announce: height/committed")
+            if not isinstance(d.get("round", -1), int) or not isinstance(
+                d.get("step", -1), int
+            ):
+                raise ValueError("malformed announce: round/step")
             peer.set(PEER_HEIGHT_KEY, d["committed"])
             ps = self._peer_state(peer)
             if ps.height != d["height"]:
@@ -230,7 +241,12 @@ class ConsensusReactor(Reactor):
             ps.committed = d["committed"]
             ps.has_proposal = bool(d.get("has_proposal", False))
             # the peer's announce is the AUTHORITATIVE current-round mask
-            # (a superset of anything we optimistically recorded)
+            # (a superset of anything we optimistically recorded).
+            # Bounded parse: a hostile multi-megabyte hex string would
+            # otherwise become a million-bit int consulted per vote
+            for f in ("prevotes", "precommits"):
+                if len(str(d.get(f, ""))) > 2048:  # 8192 validators
+                    raise ValueError("oversized vote mask in announce")
             if "prevotes" in d:
                 ps.vote_masks[(ps.round, PREVOTE)] = (
                     ps.vote_masks.get((ps.round, PREVOTE), 0)

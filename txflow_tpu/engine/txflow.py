@@ -583,13 +583,22 @@ class TxFlow:
         fast-path node applied it twice and forked from catch-up nodes)."""
         import hashlib
 
-        tx_hash = hashlib.sha256(tx).hexdigest().upper()
+        tx_key = hashlib.sha256(tx).digest()
+        tx_hash = tx_key.hex().upper()
         with self._mtx:
-            return (
-                self._committed.__contains__(_hash_key(tx_hash))
-                or tx_hash in self.vote_sets
-                or self.tx_store.has_tx(tx_hash)
-            )
+            if self._committed.__contains__(_hash_key(tx_hash)) or (
+                self.tx_store.has_tx(tx_hash)
+            ):
+                return True
+            if tx_hash not in self.vote_sets:
+                return False
+            # An in-flight vote set only reserves the tx if a fast quorum
+            # is actually POSSIBLE: for a block-only tx (app CheckTx
+            # fast_path=False) honest validators never sign, so a single
+            # byzantine vote would otherwise wedge it forever — reserved
+            # out of every proposal, never fast-committed (r5 review:
+            # one stray vote silently censored a validator rotation)
+            return self.mempool.fast_path_of(tx_key) is not False
 
     def claim_vtx(self, tx: bytes) -> bool:
         """Block-path arbitration for a vtx about to be applied with a

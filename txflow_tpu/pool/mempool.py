@@ -185,6 +185,13 @@ class Mempool(IngestLogPool):
         entry = self._txs.get(tx_key)
         return entry.tx if entry is not None else None
 
+    def fast_path_of(self, tx_key: bytes) -> bool | None:
+        """The app's CheckTx eligibility verdict for a pooled tx (None =
+        not in the pool). Lock-free like get_tx: content-addressed, and
+        the flag is immutable per entry."""
+        entry = self._txs.get(tx_key)
+        return entry.fast_path if entry is not None else None
+
     def has_sender(self, tx_key: bytes, sender_id: int) -> bool:
         with self._mtx:
             entry = self._txs.get(tx_key)
