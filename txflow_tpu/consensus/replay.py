@@ -49,6 +49,14 @@ class Handshaker:
         self.initial_state = state
         self.block_store = block_store
         self.genesis = genesis
+        # certificates whose APPLY could not be replayed (bytes in no
+        # replayed block and not in the mempool WAL): the node hands these
+        # to the engine's deferred-apply map so a catchup block carrying
+        # the tx (claim_vtx) or late mempool arrival delivers it — the
+        # restart analog of the quorum-before-tx deferral (r5: a rebuilt
+        # app silently missed such txs and claim_vtx refused the block
+        # delivery because the certificate existed)
+        self.unapplied_commits: list[tuple[str, bytes]] = []
         self.tx_store = tx_store
         self.mempool = mempool
         self.n_blocks_replayed = 0
@@ -65,6 +73,18 @@ class Handshaker:
           saved ABCI responses, WITHOUT re-delivering txs the app already
           committed, so state, store, and app agree before consensus
           starts and block H is never executed twice.
+
+        Durable-app contract: for an app that persists its own state
+        (app_height > 0 at handshake), a fast-path certificate at crash
+        time is ambiguous — the apply may or may not have reached the app
+        (store-then-apply order). The replay resolves the ambiguity the
+        reference's way ("at most once"): entries with bytes available
+        are redelivered only when no replayed block carried them; entries
+        without bytes are skipped, never deferred (deferring would
+        double-apply when a catchup block re-carries the tx). Apps
+        needing exactly-once across crashes should restart EMPTY and be
+        rebuilt by this replay — the framework's documented fast-path
+        crash model (tests/test_crash_recovery.py).
         """
         info = proxy_app.query.info_sync()
         app_height = info.last_block_height
@@ -167,11 +187,24 @@ class Handshaker:
         if self.tx_store is not None and self.mempool is not None:
             for tx_hash in self.tx_store.committed_hashes_in_order():
                 key = bytes.fromhex(tx_hash)
-                if key in block_txs:
+                # dedup against BOTH the window set and every block this
+                # handshake replayed/credited (r5 review: on chains older
+                # than DEDUP_WINDOW the windowed set alone let historical
+                # entries be re-delivered — or worse, spuriously deferred)
+                if key in block_txs or key in delivered:
                     continue  # already delivered via block replay
                 tx = self.mempool.get_tx(key)
                 if tx is None:
-                    continue  # tx bytes unavailable (not in mempool WAL)
+                    if app_height == 0:
+                        # rebuilt-empty app (the framework's fast-path
+                        # crash model): the apply is genuinely owed —
+                        # DEFER it (see unapplied_commits in __init__)
+                        self.unapplied_commits.append((tx_hash, key))
+                    # durable app (app_height > 0): every certificate at
+                    # or below its height was applied synchronously
+                    # before the crash — deferring would double-apply
+                    # when a catchup block re-carries the tx (r5 review)
+                    continue
                 proxy_app.consensus.deliver_tx_async(tx)
                 proxy_app.consensus.flush()
                 res = proxy_app.consensus.commit_sync()

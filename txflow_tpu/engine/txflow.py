@@ -539,6 +539,15 @@ class TxFlow:
             and self.tx_executor.events_drained()
         )
 
+    def register_unapplied(self, pairs: list[tuple[str, bytes]]) -> None:
+        """Adopt decided-but-unapplied txs from a restart handshake (see
+        Handshaker.unapplied_commits): the certificate predates this
+        process, the apply is still owed — delivery follows the same
+        deferral rules as live quorum-before-tx commits."""
+        with self._mtx:
+            for tx_hash, tx_key in pairs:
+                self._unapplied[tx_hash] = tx_key
+
     def _apply_unapplied(self) -> None:
         """Late delivery: apply decided txs whose bytes have since
         arrived in the mempool (committer thread; see _unapplied)."""

@@ -319,14 +319,20 @@ class Node:
         # the handshake may advance state past the snapshot loaded in
         # __init__ (crash between block save and state save) — every
         # component keyed on height/validators must adopt the result
-        new_state = Handshaker(
+        handshaker = Handshaker(
             self.state_store,
             self.chain_state,
             self.block_store,
             genesis=self.genesis,
             tx_store=self.tx_store,
             mempool=self.mempool,
-        ).handshake(self.proxy_app)
+        )
+        new_state = handshaker.handshake(self.proxy_app)
+        if handshaker.unapplied_commits:
+            # certificates whose bytes were unavailable at replay: hand
+            # them to the engine's deferral map — a catchup block's vtx
+            # (claim_vtx) or late mempool gossip delivers them
+            self.txflow.register_unapplied(handshaker.unapplied_commits)
         if new_state.last_block_height != self.chain_state.last_block_height:
             self.chain_state = new_state
             with self._state_mtx:
