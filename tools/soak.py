@@ -5,12 +5,17 @@ Dev tool (not part of the test suite — wall-clock minutes): exercises the
 full stack the way a flaky validator set would — fast path + block
 ticker, hostile votes (bad sig, unknown validator, oversized fields),
 repeated partitions and heals — then checks for forks, stalls, and leaks.
-Usage: JAX_PLATFORMS=cpu python tools/soak.py [seconds] [--rotate]
+Usage: JAX_PLATFORMS=cpu python tools/soak.py [seconds] [--rotate] [--restart]
+--restart periodically stops one durable node, rebuilds it over its
+artifacts (fresh app, handshake replay + catchup), and reconnects it —
+the restart x partition x load interleaving that exposed the r5
+replay-deferral bug.
 """
 
 import os
 import random
 import sys
+import tempfile
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -19,7 +24,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import hashlib
 
 from txflow_tpu.node import LocalNet
+from txflow_tpu.node.node import Node, NodeConfig
 from txflow_tpu.p2p import connect_switches
+from txflow_tpu.store.db import FileDB
 from txflow_tpu.types import TxVote
 from txflow_tpu.types.priv_validator import MockPV
 from txflow_tpu.utils.config import test_config
@@ -39,7 +46,40 @@ def main() -> None:
     net = LocalNet(
         4, use_device_verifier=False, enable_consensus=True, config=cfg
     )
+    restart_mode = "--restart" in sys.argv
+    restart_dir = tempfile.mkdtemp(prefix="soak-restart-") if restart_mode else ""
+    if restart_mode:
+        # node 2 becomes DURABLE so it can be rebuilt over its artifacts
+        from txflow_tpu.abci.kvstore import KVStoreApplication
+
+        def build_node2():
+            return Node(
+                node_id="node2",
+                chain_id=net.chain_id,
+                val_set=net.val_set,
+                app=KVStoreApplication(),
+                priv_val=net.priv_vals[2],
+                node_config=NodeConfig(
+                    config=cfg,
+                    use_device_verifier=False,
+                    enable_consensus=True,
+                    consensus_wal_path=f"{restart_dir}/consensus.wal",
+                ),
+                tx_store_db=FileDB(f"{restart_dir}/txstore.db"),
+                state_db=FileDB(f"{restart_dir}/state.db"),
+                block_db=FileDB(f"{restart_dir}/blocks.db"),
+            )
+
+        net.nodes[2] = build_node2()
+
+        def revive_node2():
+            net.nodes[2] = build_node2()
+            net.nodes[2].start()
+            for j in (0, 1, 3):
+                connect_switches(net.nodes[2].switch, net.nodes[j].switch)
+
     net.start()
+    down_since: float | None = None
     evil = MockPV()
     sent: list[bytes] = []
     t0 = time.monotonic()
@@ -48,16 +88,17 @@ def main() -> None:
     try:
         while time.monotonic() - t0 < duration:
             phase += 1
-            # 1) steady tx load to a random node
+            # 1) steady tx load to a random LIVE node
+            live_idx = [i for i in range(4) if not (i == 2 and down_since is not None)]
             for _ in range(rng.randrange(3, 12)):
                 tx = b"soak-%d-%d=v" % (phase, rng.randrange(1 << 30))
                 sent.append(tx)
                 try:
-                    net.broadcast_tx(tx, node_index=rng.randrange(4))
+                    net.broadcast_tx(tx, node_index=rng.choice(live_idx))
                 except Exception:
                     pass
-            # 2) hostile injections into a random node's pool
-            node = net.nodes[rng.randrange(4)]
+            # 2) hostile injections into a random live node's pool
+            node = net.nodes[rng.choice(live_idx)]
             kind = rng.randrange(3)
             key = hashlib.sha256(b"hostile-%d" % phase).digest()
             v = TxVote(
@@ -89,14 +130,27 @@ def main() -> None:
                 try:
                     net.broadcast_tx(
                         b"val:%s!%d" % (pub.encode(), power),
-                        node_index=rng.randrange(4),
+                        node_index=rng.choice(live_idx),
                     )
                 except Exception:
                     pass
+            # 2c) restart churn (--restart): stop the durable node, let
+            # the others commit without it for a while, then rebuild it
+            # over its artifacts and reconnect
+            if restart_mode and down_since is None and phase % 40 == 20:
+                # never overlap with a partition cut involving node 2
+                if cut is None or 2 not in cut:
+                    net.nodes[2].stop()
+                    down_since = time.monotonic()
+            elif restart_mode and down_since is not None and (
+                time.monotonic() - down_since > 4.0
+            ):
+                revive_node2()
+                down_since = None
             # 3) partition / heal churn (~every 8 phases): drop the link
             # between one random pair, later reconnect it
             if cut is None and phase % 8 == 3:
-                i, j = rng.sample(range(4), 2)
+                i, j = rng.sample(live_idx, 2) if len(live_idx) >= 2 else (0, 1)
                 for a, b in ((i, j), (j, i)):
                     sw = net.nodes[a].switch
                     peer = sw.get_peer(net.nodes[b].switch.node_id)
@@ -108,7 +162,10 @@ def main() -> None:
                 cut = None
             time.sleep(0.05)
 
-        # quiescence: heal, stop load, wait for convergence
+        # quiescence: revive, heal, stop load, wait for convergence
+        if restart_mode and down_since is not None:
+            revive_node2()
+            down_since = None
         if cut is not None:
             connect_switches(net.nodes[cut[0]].switch, net.nodes[cut[1]].switch)
         tail = sent[-200:]

@@ -546,6 +546,14 @@ class TxFlow:
         deferral rules as live quorum-before-tx commits."""
         with self._mtx:
             for tx_hash, tx_key in pairs:
+                if tx_hash not in self._unapplied:
+                    # each owed apply counts as a decided commit from the
+                    # prior life, balancing the += 1 its eventual delivery
+                    # (claim_vtx / retry) credits — otherwise applied
+                    # would run ahead of decided and commits_drained()
+                    # could report True over live queued commits (r5
+                    # review)
+                    self._decided_count += 1
                 self._unapplied[tx_hash] = tx_key
 
     def _apply_unapplied(self) -> None:
