@@ -203,17 +203,10 @@ def run_bench(platform: str) -> dict:
             val_set, buckets=(bucket, 4 * bucket), shared_cache=share_cache
         )
         t0 = time.time()
-        # warm the shape combos the run will hit: with the cache on, all
-        # device calls are verify-only at (B, min-slot-bucket); without
-        # it, (B, S) = (bucket, bucket) solo / 4x-bucket merged combos
-        shared_verifier.warmup()
-        for n, n_slots in ((bucket, bucket), (bucket + 1, 1), (bucket + 1, bucket + 1)):
-            shared_verifier.verify_and_tally(
-                [b""] * n, [b""] * n,
-                __import__("numpy").zeros(n, "int64"),
-                __import__("numpy").zeros(n, "int64"),
-                n_slots,
-            )
+        # warm every shape the run can hit (verifier.warmup full=True:
+        # the cached path's _verify_only miss ladder, or the no-cache
+        # fused combos) — a cold shape would compile mid-measurement
+        shared_verifier.warmup(full=True)
         print(f"bench: kernel warm in {time.time()-t0:.1f}s", file=sys.stderr)
 
         # supplementary metric: steady-state device-step throughput at the

@@ -27,7 +27,7 @@ def make_pvs(n=4):
     return [by_addr[v.address] for v in vals], vals
 
 
-def make_engine(vals, app=None, use_device=True, max_batch=1024):
+def make_engine(vals, app=None, use_device=True, max_batch=1024, verifier=None):
     conns = AppConns(app or KVStoreApplication())
     mempool = Mempool(MempoolConfig(cache_size=1000), conns.mempool)
     commitpool = Mempool(MempoolConfig(cache_size=1000))
@@ -45,6 +45,7 @@ def make_engine(vals, app=None, use_device=True, max_batch=1024):
         execu,
         tx_store,
         config=EngineConfig(max_batch=max_batch, use_device=use_device),
+        verifier=verifier,
     )
     return flow, mempool, commitpool, votepool, tx_store, conns.app, bus
 
@@ -318,3 +319,110 @@ def test_quorum_before_tx_defers_apply_until_bytes_arrive():
         assert tx_hash not in net.nodes[0].txflow._unapplied
     finally:
         net.stop()
+
+
+def test_two_engines_shared_cache_both_commit():
+    """Two co-located engines sharing one VerifyCache (the bench/LocalNet
+    deployment shape): claim semantics mean an engine meeting the other's
+    in-flight verifies DEFERS those votes and re-offers them next step —
+    both engines must still commit every tx, each verifying only a share
+    of the unique votes (process-wide verify count < 2x the vote count)."""
+    import threading
+    import time as _time
+
+    from txflow_tpu.verifier import ScalarVoteVerifier, VerifyCache
+
+    pvs, vals = make_pvs(4)
+    cache = VerifyCache()
+    engines = []
+    for _ in range(2):
+        ver = ScalarVoteVerifier(vals, shared_cache=cache)
+        flow, mempool, commitpool, votepool, tx_store, app, bus = make_engine(
+            vals, use_device=False, verifier=ver
+        )
+        engines.append((flow, mempool, votepool, app))
+
+    txs = [b"sc%d=v" % i for i in range(40)]
+    votes = [sign_vote(pv, tx) for tx in txs for pv in pvs[:3]]
+    for flow, mempool, votepool, app in engines:
+        for tx in txs:
+            mempool.check_tx(tx)
+
+    # start both engines, then feed votes so the step loops race on the
+    # same misses (the deterministic single-step path can't interleave)
+    for flow, *_ in engines:
+        flow.start()
+    try:
+        for v in votes:
+            for _, _, votepool, _ in engines:
+                votepool.check_tx(v)
+        deadline = _time.monotonic() + 20
+        while _time.monotonic() < deadline:
+            if all(app.tx_count == len(txs) for *_, app in engines):
+                break
+            _time.sleep(0.01)
+        for flow, _, votepool, app in engines:
+            assert app.tx_count == len(txs), (
+                f"engine committed {app.tx_count}/{len(txs)}"
+            )
+    finally:
+        for flow, *_ in engines:
+            flow.stop()
+    # sharing must have deduped verify work: misses == claimed verifies,
+    # and claims guarantee each unique vote is verified at most once
+    # process-wide (absent TTL expiry, which this run is too short for)
+    assert cache.misses <= len(votes)
+    assert cache.hits > 0
+
+
+def test_block_claim_before_committer_wake_credits_apply_once():
+    """A quorum decided without tx bytes is queued for the committer AND
+    registered as unapplied; if a block claims the delivery (claim_vtx)
+    before the committer wake processes the queued item, the apply credit
+    must be taken exactly once — double-counting let commits_drained()
+    report True while later decided commits were still queued (r5
+    review)."""
+    from txflow_tpu.types import TxVoteSet
+
+    pvs, vals = make_pvs(4)
+    flow, mempool, commitpool, votepool, tx_store, app, _ = make_engine(
+        vals, use_device=False
+    )
+    # no flow.start(): the committer wake is driven by hand below
+    tx = b"claimrace=1"  # bytes NEVER enter the mempool
+    tx_hash = hashlib.sha256(tx).hexdigest().upper()
+    vs = TxVoteSet(CHAIN_ID, HEIGHT, tx_hash, hashlib.sha256(tx).digest(), vals)
+    for pv in pvs[:3]:
+        added, err = vs.add_vote(sign_vote(pv, tx))
+        assert added, err
+    with flow._mtx:
+        flow._enqueue_commit(vs)
+    assert flow._decided_count == 1 and tx_hash in flow._unapplied
+
+    # block path claims the delivery first (this credits the apply)
+    assert flow.claim_vtx(tx) is True
+    assert flow._applied_count == 1
+
+    # the committer wake now processes the stale queued item: it must NOT
+    # credit the apply again
+    item = flow._commit_q.get_nowait()
+    flow._commit_batch([item], purge=[], interval=1)
+    assert flow._applied_count == 1, "apply credited twice for one decision"
+
+    # a second, normal decision must still be visibly un-drained until its
+    # own wake applies it
+    tx2 = b"claimrace=2"
+    mempool.check_tx(tx2)
+    tx2_hash = hashlib.sha256(tx2).hexdigest().upper()
+    vs2 = TxVoteSet(CHAIN_ID, HEIGHT, tx2_hash, hashlib.sha256(tx2).digest(), vals)
+    for pv in pvs[:3]:
+        vs2.add_vote(sign_vote(pv, tx2))
+    with flow._mtx:
+        flow._enqueue_commit(vs2)
+    assert not flow.commits_drained(), (
+        "drained while a decided commit is still queued"
+    )
+    item2 = flow._commit_q.get_nowait()
+    flow._commit_batch([item2], purge=[], interval=1)
+    assert flow._applied_count == 2 == flow._decided_count
+    assert app.tx_count == 1  # only tx2 applied here (tx1 went to a block)

@@ -472,3 +472,118 @@ def test_large_validator_set_parity(nv):
         vals, seeds, n_txs=3, corrupt=("ok", "flip", "ok", "wrongkey")
     )
     assert_parity(vals, msgs, sigs, vidx, slot, 3)
+
+
+def test_verify_cache_claims_dedupe_inflight():
+    """Claim semantics (r5: co-located engines racing on the same misses
+    each paid a full padded device call — 580 votes/s on TPU vs 12k
+    uncached): the first asker owns a miss; concurrent askers are told
+    it is pending and must defer; store resolves it for everyone;
+    release hands an abandoned claim to the next asker."""
+    from txflow_tpu.verifier import VerifyCache
+
+    cache = VerifyCache()
+    k = VerifyCache.key(b"m", b"s" * 64, b"p" * 32)
+    vals, pending = cache.lookup_or_claim_many([k])
+    assert vals == [None] and not pending[0]  # this caller owns the claim
+    vals2, pending2 = cache.lookup_or_claim_many([k])
+    assert vals2 == [None] and pending2[0]  # concurrent asker defers
+    cache.store_many([(k, True)])
+    vals3, pending3 = cache.lookup_or_claim_many([k])
+    assert vals3 == [True] and not pending3[0]  # resolved for everyone
+
+    # release without a verdict: next asker becomes the owner
+    k2 = VerifyCache.key(b"m2", b"s" * 64, b"p" * 32)
+    cache.lookup_or_claim_many([k2])
+    cache.release_many([k2])
+    v, p = cache.lookup_or_claim_many([k2])
+    assert v == [None] and not p[0]
+
+    # None keys are never claimed or pending
+    v, p = cache.lookup_or_claim_many([None])
+    assert v == [None] and not p[0]
+
+
+def test_verify_cache_claim_ttl_reclaims_abandoned():
+    """A claim whose owner died mid-verify must not stall waiters
+    forever: past claim_ttl the next asker takes ownership."""
+    import time as _time
+
+    from txflow_tpu.verifier import VerifyCache
+
+    cache = VerifyCache(claim_ttl=0.02)
+    k = VerifyCache.key(b"m", b"s" * 64, b"p" * 32)
+    cache.lookup_or_claim_many([k])
+    _, p = cache.lookup_or_claim_many([k])
+    assert p[0]  # fresh claim: still owned elsewhere
+    _time.sleep(0.03)
+    v, p = cache.lookup_or_claim_many([k])
+    assert v == [None] and not p[0]  # stale claim handed over
+
+
+def test_shared_cache_pending_defers_instead_of_failing():
+    """An engine that meets another engine's in-flight verifies must
+    report those votes as dropped (deferred for retry) — never as
+    invalid — and must resolve them to the correct verdicts once the
+    owner stores. Deferred votes also must not contribute stake."""
+    from txflow_tpu.verifier import VerifyCache
+
+    vals, seeds = make_valset(4)
+    cache = VerifyCache()
+    golden = ScalarVoteVerifier(vals)
+    eng_b = ScalarVoteVerifier(vals, shared_cache=cache)
+
+    msgs, sigs, vidx, slot = make_batch(vals, seeds, n_txs=3)
+    n_slots = 3
+    keys = [
+        VerifyCache.key(msgs[i], sigs[i], eng_b._pub_keys[int(vidx[i])])
+        for i in range(len(msgs))
+    ]
+    # simulate engine A holding claims on every vote (mid-device-call)
+    _, pend = cache.lookup_or_claim_many(keys)
+    assert not pend.any()
+
+    got = eng_b.verify_and_tally(msgs, sigs, vidx, slot, n_slots)
+    assert got.dropped.all(), "pending votes must come back deferred"
+    assert not got.valid.any()
+    assert (got.stake == 0).all() and not got.maj23.any()
+
+    # engine A finishes: stores the true verdicts; B's retry is all hits
+    want = golden.verify_and_tally(msgs, sigs, vidx, slot, n_slots)
+    cache.store_many([(keys[i], bool(want.valid[i])) for i in range(len(keys))])
+    before = cache.misses
+    got2 = eng_b.verify_and_tally(msgs, sigs, vidx, slot, n_slots)
+    np.testing.assert_array_equal(want.valid, got2.valid)
+    np.testing.assert_array_equal(want.stake, got2.stake)
+    np.testing.assert_array_equal(want.maj23, got2.maj23)
+    np.testing.assert_array_equal(want.dropped, got2.dropped)
+    assert cache.misses == before, "retry after store must be all hits"
+
+
+def test_device_cached_pending_defers(valset4):
+    """Device cached path: same deferral contract as the scalar one."""
+    from txflow_tpu.verifier import VerifyCache
+
+    vals, seeds = valset4
+    cache = VerifyCache()
+    dev = DeviceVoteVerifier(vals, shared_cache=cache)
+    golden = ScalarVoteVerifier(vals)
+    msgs, sigs, vidx, slot = make_batch(vals, seeds, n_txs=2)
+    keys = [
+        VerifyCache_key_for(dev, msgs[i], sigs[i], int(vidx[i]))
+        for i in range(len(msgs))
+    ]
+    cache.lookup_or_claim_many(keys)  # another engine owns everything
+    got = dev.verify_and_tally(msgs, sigs, vidx, slot, 2)
+    assert got.dropped.all() and not got.valid.any()
+    cache.release_many(keys)  # owner aborted: dev may now verify
+    got2 = dev.verify_and_tally(msgs, sigs, vidx, slot, 2)
+    want = golden.verify_and_tally(msgs, sigs, vidx, slot, 2)
+    np.testing.assert_array_equal(want.valid, got2.valid)
+    np.testing.assert_array_equal(want.maj23, got2.maj23)
+
+
+def VerifyCache_key_for(verifier, msg, sig, vi):
+    from txflow_tpu.verifier import VerifyCache
+
+    return VerifyCache.key(msg, sig, verifier._pub_keys[vi])

@@ -1,0 +1,46 @@
+#!/bin/bash
+# BASELINE config measurement campaign (VERDICT r4 item 3).
+#
+# Runs the non-default bench configs back-to-back on the live TPU tunnel,
+# capturing each run's JSON line into bench_artifacts/. Config 1 (default,
+# 4 validators) is NOT here: plain `python bench.py` runs it and banks
+# bench_artifacts/tpu_latest.json itself.
+#
+# Usage: bash tools/measure_campaign.sh [platform]
+#   platform (default "tpu"): passed as BENCH_PLATFORM so the runs skip
+#   the 600 s probe; the caller is asserting the tunnel is alive.
+set -u
+cd "$(dirname "$0")/.."
+PLAT="${1:-tpu}"
+ART=bench_artifacts
+mkdir -p "$ART"
+
+run() { # name, extra env as VAR=VAL...
+  local name="$1"; shift
+  echo "=== $name ($*) $(date -u +%H:%M:%S) ===" >&2
+  # per-run timeout generous enough for fresh shape compiles (16/64 vals)
+  if env BENCH_PLATFORM="$PLAT" "$@" timeout 2400 python bench.py \
+      > "$ART/$name.tmp" 2> "$ART/$name.stderr"; then
+    tail -1 "$ART/$name.tmp" > "$ART/$name.json" && rm -f "$ART/$name.tmp"
+    echo "--- $name done: $(cat "$ART/$name.json" | head -c 300)" >&2
+  else
+    echo "--- $name FAILED rc=$? (stderr tail below)" >&2
+    tail -5 "$ART/$name.stderr" >&2
+  fi
+}
+
+# config 4: adversarial mix (25% corrupted votes; bench asserts zero
+# corrupted votes land in certificates)
+run tpu_byzantine_config4 BENCH_BYZANTINE=0.25 BENCH_LATENCY_SWEEP=0
+
+# config 5: consensus ticker ON alongside the fast path (target >= 80%
+# of config 1 after the r5 interference fixes)
+run tpu_consensus_config5_r5 BENCH_CONSENSUS=1 BENCH_LATENCY_SWEEP=0
+
+# config 2: 16 validators (fresh [V,16,4,32] table shape -> new compile)
+run tpu_16val_config2 BENCH_VALIDATORS=16 BENCH_LATENCY_SWEEP=0
+
+# config 3: 64 validators
+run tpu_64val_config3 BENCH_VALIDATORS=64 BENCH_LATENCY_SWEEP=0
+
+echo "campaign complete $(date -u +%H:%M:%S)" >&2

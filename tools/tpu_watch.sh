@@ -6,6 +6,13 @@ cd /root/repo
 LOG=/tmp/tpu_watch_r5.log
 LAST_BENCH=0
 while true; do
+  # a builder-side heavy CPU job (pytest / profiling) would pollute the
+  # host-path throughput measurement: wait it out BEFORE probing so the
+  # probe result the bench gates on is fresh
+  while [ -e /tmp/host_busy ]; do
+    echo "$(date +%H:%M:%S) host busy; deferring probe+bench" >> "$LOG"
+    sleep 60
+  done
   out=$(timeout -k 5 90 python -c "
 import os
 os.environ.setdefault('JAX_COMPILATION_CACHE_DIR', os.path.abspath('.jax_cache'))
@@ -53,7 +60,7 @@ print('TPU kernel radix %d: %.0f votes/s at B=%d' % (fe.RADIX, B/dt, B))
       done
       # BASELINE configs: 16-val (config 2), 64-val (config 3), consensus-on
       # (config 5) — the judge's still-unmeasured table rows (r4 items 3)
-      for CFG in "BENCH_VALIDATORS=16:cfg2_16val" "BENCH_VALIDATORS=64:cfg3_64val" "BENCH_CONSENSUS=1:cfg5_consensus"; do
+      for CFG in "BENCH_VALIDATORS=16:cfg2_16val" "BENCH_VALIDATORS=64:cfg3_64val" "BENCH_CONSENSUS=1:cfg5_consensus" "BENCH_BYZANTINE=0.25:cfg4_byzantine"; do
         SPEC="${CFG%%:*}"; NAME="${CFG##*:}"
         echo "$(date +%H:%M:%S) running $NAME" >> "$LOG"
         timeout -k 5 3600 env "$SPEC" BENCH_LATENCY=0 python bench.py           > "bench_artifacts/tpu_${NAME}_r5.json" 2>>"$LOG"

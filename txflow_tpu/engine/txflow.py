@@ -333,6 +333,18 @@ class TxFlow:
                 self.tx_vote_pool.remove(bad_keys)
 
         self.metrics.step_time.observe(time.perf_counter() - t0)
+        if sum(dropped_l) == len(votes):
+            # every vote deferred (another engine owns the in-flight
+            # verifies — shared VerifyCache claims): the results land in
+            # the cache when the owner's verify finishes, which takes a
+            # device step / a scalar sweep (~100 ms class, not ~1 ms) —
+            # back off on that scale or this loop busy-spins the whole
+            # step preamble (drain + sign-bytes + key build) against the
+            # owner's in-flight call for nothing. A pool wait (not a
+            # sleep) so genuinely new votes still wake the engine early.
+            self.tx_vote_pool.wait_for_new(
+                self.tx_vote_pool.seq(), timeout=self.config.defer_backoff
+            )
         return len(votes) + len(drop_now)
 
     # ---- scalar parity API (reference TryAddVote :169-188) ----
@@ -486,16 +498,22 @@ class TxFlow:
         self.tx_store.save_txs_batch([(vs, votes) for vs, votes, _ in items])
         apply_items: list[tuple] = []
         deferred = 0
+        retired = 0  # applied by claim_vtx/_apply_unapplied before this wake
         for vs, votes, tx in items:
             self.metrics.committed_votes.add(len(votes))
             purge.extend(votes)
             if tx is None:
                 # deferral was registered at decision time; try to retire
                 # it now — unless claim_vtx already handed the delivery to
-                # a block in the meantime (then we must NOT apply)
+                # a block (or _apply_unapplied beat this wake to it): then
+                # we must NOT apply, and the +1 applied credit was ALREADY
+                # taken by whoever retired it — counting it again here
+                # would let commits_drained() report True over live queued
+                # commits (r5 review: applied running ahead of decided)
                 with self._mtx:
                     if vs.tx_hash not in self._unapplied:
-                        continue  # block path owns the delivery now
+                        retired += 1
+                        continue  # another path owns/owned the delivery
                     tx = self.mempool.get_tx(vs.tx_key)
                     if tx is None:
                         deferred += 1
@@ -503,7 +521,7 @@ class TxFlow:
                     del self._unapplied[vs.tx_hash]
             apply_items.append((vs, tx))
         if not apply_items:
-            self._applied_count += len(items) - deferred
+            self._applied_count += len(items) - deferred - retired
             return
         for base in range(0, len(apply_items), interval):
             group = apply_items[base : base + interval]
@@ -523,7 +541,7 @@ class TxFlow:
         self.commitpool.push_committed_many(
             [tx for _, tx in apply_items], [vs.tx_key for vs, _ in apply_items]
         )
-        self._applied_count += len(items) - deferred
+        self._applied_count += len(items) - deferred - retired
 
     def commits_drained(self) -> bool:
         """True when every decided commit has been applied (the pipelined

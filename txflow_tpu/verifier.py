@@ -22,6 +22,7 @@ exactly like the reference.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -73,15 +74,25 @@ class VerifyCache:
     never arises (txflow/service.go:123-166 verifies serially per node).
     """
 
-    def __init__(self, capacity: int = 1 << 17):
+    def __init__(self, capacity: int = 1 << 17, claim_ttl: float = 3.0):
         import threading
         from collections import OrderedDict
 
         self.capacity = capacity
+        self.claim_ttl = claim_ttl
         self._mtx = threading.Lock()
         self._d: OrderedDict[bytes, bool] = OrderedDict()
+        # in-flight claims: key -> monotonic claim time. Without claims,
+        # co-located engines that miss on the SAME votes all ship them to
+        # the device in the same beat — N redundant verifies AND (worse,
+        # measured r5 on TPU: 580 votes/s vs 12k without the cache) each
+        # engine pays a full padded device call for its tiny private miss
+        # set. A claim hands each vote to exactly one engine; the others
+        # defer the vote to their next step, by which time it is a hit.
+        self._inflight: dict[bytes, float] = {}
         self.hits = 0
         self.misses = 0
+        self.deferrals = 0
 
     @staticmethod
     def key(msg: bytes, sig: bytes, pub_key: bytes) -> bytes:
@@ -95,29 +106,62 @@ class VerifyCache:
             + pub_key
         )
 
-    def lookup_many(self, keys: list[bytes | None]) -> list[bool | None]:
-        """One lock hold for the whole batch; None = miss (or None key)."""
-        out: list[bool | None] = [None] * len(keys)
+    def lookup_or_claim_many(
+        self, keys: list[bytes | None]
+    ) -> tuple[list[bool | None], np.ndarray]:
+        """One lock hold: resolve hits, CLAIM unclaimed misses for this
+        caller, and flag misses already in flight elsewhere.
+
+        Returns (vals, pending): vals[i] is the cached verdict or None for
+        a miss; pending[i] is True when the miss is owned by another
+        caller — the caller must NOT verify it (defer/re-offer instead)
+        and None-vals with pending False are claimed by THIS caller, which
+        must eventually store_many or release_many them. Claims older than
+        claim_ttl are treated as abandoned (owner died mid-verify) and
+        handed to the next asker.
+        """
+        n = len(keys)
+        vals: list[bool | None] = [None] * n
+        pending = np.zeros(n, dtype=bool)
+        now = time.monotonic()
+        stale = now - self.claim_ttl
         with self._mtx:
             d = self._d
+            infl = self._inflight
             for i, k in enumerate(keys):
                 if k is None:
                     continue
                 v = d.get(k)
                 if v is not None:
                     d.move_to_end(k)
-                    out[i] = v
+                    vals[i] = v
                     self.hits += 1
+                    continue
+                t = infl.get(k)
+                if t is not None and t > stale:
+                    # another caller's verify is in flight: a deferral,
+                    # not a miss — misses counts actual claimed verifies
+                    pending[i] = True
+                    self.deferrals += 1
                 else:
                     self.misses += 1
-        return out
+                    infl[k] = now  # claimed by this caller
+        return vals, pending
+
+    def release_many(self, keys: list[bytes]) -> None:
+        """Drop claims without storing results (verify failed/raised)."""
+        with self._mtx:
+            for k in keys:
+                self._inflight.pop(k, None)
 
     def store_many(self, pairs: list[tuple[bytes, bool]]) -> None:
         with self._mtx:
             d = self._d
+            infl = self._inflight
             for k, v in pairs:
                 d[k] = v
                 d.move_to_end(k)
+                infl.pop(k, None)
             while len(d) > self.capacity:
                 d.popitem(last=False)
 
@@ -201,6 +245,7 @@ class ScalarVoteVerifier:
         n = len(msgs)
         keep = first_occurrence_mask(tx_slot, val_idx)
         valid = np.zeros(n, dtype=bool)
+        pending = np.zeros(n, dtype=bool)
         if self.cache is not None:
             keys = [
                 VerifyCache.key(msgs[i], sigs[i], self._pub_keys[int(val_idx[i])])
@@ -208,18 +253,39 @@ class ScalarVoteVerifier:
                 else None
                 for i in range(n)
             ]
-            cached = self.cache.lookup_many(keys)
+            # claim semantics (VerifyCache.lookup_or_claim_many): misses
+            # another engine has in flight come back pending and are
+            # DEFERRED (dropped mask), not re-verified — each unique vote
+            # costs one host verify process-wide instead of one per engine
+            cached, pending = self.cache.lookup_or_claim_many(keys)
             stores = []
-            for i in range(n):
-                if keys[i] is None:
-                    continue
-                if cached[i] is not None:
-                    valid[i] = cached[i]
-                else:
-                    valid[i] = host_ed.verify(
-                        self._pub_keys[int(val_idx[i])], msgs[i], sigs[i]
-                    )
-                    stores.append((keys[i], bool(valid[i])))
+            try:
+                for i in range(n):
+                    if keys[i] is None or pending[i]:
+                        continue
+                    if cached[i] is not None:
+                        valid[i] = cached[i]
+                    else:
+                        valid[i] = host_ed.verify(
+                            self._pub_keys[int(val_idx[i])], msgs[i], sigs[i]
+                        )
+                        stores.append((keys[i], bool(valid[i])))
+            except BaseException:
+                # free every claimed-but-unverified key (waiters would
+                # otherwise stall until the TTL), then surface the error
+                done = {k for k, _ in stores}
+                self.cache.release_many(
+                    [
+                        keys[i]
+                        for i in range(n)
+                        if keys[i] is not None
+                        and not pending[i]
+                        and cached[i] is None
+                        and keys[i] not in done
+                    ]
+                )
+                self.cache.store_many(stores)
+                raise
             if stores:
                 self.cache.store_many(stores)
         else:
@@ -237,7 +303,7 @@ class ScalarVoteVerifier:
             if valid[i] and 0 <= s < n_slots:
                 stake[s] += int(self._powers[val_idx[i]])
         q = self.val_set.quorum_power() if quorum is None else quorum
-        return TallyResult(valid, stake, stake >= q, ~keep)
+        return TallyResult(valid, stake, stake >= q, ~keep | pending)
 
 
 class DeviceVoteVerifier:
@@ -280,6 +346,13 @@ class DeviceVoteVerifier:
         # past it, bucket_size degrades to exact-size rounding and every
         # new batch size triggers a fresh (minutes-long on TPU) compile
         self.max_batch = max(buckets)
+        # cached-path miss sets get a finer ladder (claims shrink them to
+        # ~1/N_engines of a drain, i.e. quarter-drains for the 4-engine
+        # LocalNet): one extra shape per bucket, a one-time compile banked
+        # in the persistent cache
+        self.miss_buckets = tuple(
+            sorted({max(64, b // 4) for b in buckets} | set(buckets))
+        )
         self.mesh = mesh
         # kick the native prep build NOW (cc -O3, seconds when stale): the
         # first lazy build would otherwise land inside the first verify
@@ -306,16 +379,46 @@ class DeviceVoteVerifier:
             self._tables_dev = self.epoch.device_tables()
             self._powers_dev = jax.numpy.asarray(self._powers)
 
-    def warmup(self, n: int = 1) -> None:
+    def warmup(self, n: int = 1, full: bool = False) -> None:
         """Compile the kernel for the bucket shapes of an n-vote batch.
 
         Call ONCE before concurrent engines share this verifier: N threads
         racing to compile the same uncached shape is at best N redundant
         ~90 s compiles and at worst a remote-compile transport error
-        (observed on the tunneled axon backend, r3)."""
+        (observed on the tunneled axon backend, r3).
+
+        full=True additionally compiles the shapes loaded runs hit: with
+        a shared cache attached, the whole _verify_only miss ladder (the
+        fused shapes are unreachable while the cache is on); without one,
+        the fused (batch-bucket, slot-bucket) combos — (b, b) and
+        (b, smallest) for every bucket b, the combos engine drains
+        produce (slots = unique txs <= votes, so slot buckets other than
+        the batch's own and the floor are rare). A shape left cold here
+        compiles MID-RUN on the first batch that hits it, stalling the
+        pipeline for the entire compile (r5 measured: a 169 s throughput
+        phase containing ~160 s of one such compile)."""
         self.verify_and_tally(
             [b""] * n, [b""] * n, np.zeros(n, np.int64), np.zeros(n, np.int64), 1
         )
+        if not full:
+            return
+        if self.cache is not None:
+            for b in self.miss_buckets:
+                self._verify_only(
+                    [b"warm-%d" % i for i in range(b)],
+                    [b"\x00" * 64] * b,
+                    np.zeros(b, np.int64),
+                )
+            return
+        smallest = self.buckets[0]
+        for b in self.buckets:
+            combos = [(b, b)] if b == smallest else [(b, b), (b, smallest)]
+            for nn, n_slots in combos:
+                self.verify_and_tally(
+                    [b""] * nn, [b""] * nn,
+                    np.zeros(nn, np.int64), np.zeros(nn, np.int64),
+                    n_slots,
+                )
 
     def verify_and_tally(
         self,
@@ -385,12 +488,18 @@ class DeviceVoteVerifier:
         self, msgs, sigs, val_idx, tx_slot, n_slots, prior_stake, quorum,
         keep,
     ) -> TallyResult:
-        """Cache-aware path: device-verify only the cache misses, tally on
-        the host. Decisions are bit-identical to the fused kernel — the
-        tally is the same prior + segment-sum over valid first-occurrence
-        votes, and validity per vote is a pure function the cache merely
-        memoizes. With co-located engines the steady state is ~1/N_engines
-        of the device work (the rest are hits)."""
+        """Cache-aware path: device-verify only the cache misses THIS
+        caller claims, tally on the host. Decisions are bit-identical to
+        the fused kernel — the tally is the same prior + segment-sum over
+        valid first-occurrence votes, and validity per vote is a pure
+        function the cache merely memoizes. Misses another engine already
+        has in flight are NOT verified here: they come back dropped=True
+        and the engine re-offers them next step, by which time they are
+        hits (claim semantics: VerifyCache.lookup_or_claim_many). With
+        co-located engines the steady state is ~1/N_engines of the device
+        work each, with no duplicated in-flight verifies — without claims
+        the r5 TPU bench measured 580 votes/s (each engine paying a full
+        padded device call for a tiny private miss set) vs 12k uncached."""
         n = len(msgs)
         n_vals = len(self._powers)
         keys: list[bytes | None] = [
@@ -399,22 +508,28 @@ class DeviceVoteVerifier:
             else None
             for i in range(n)
         ]
-        cached = self.cache.lookup_many(keys)
+        cached, pending = self.cache.lookup_or_claim_many(keys)
         valid = np.zeros(n, dtype=bool)
         miss_idx = []
         for i in range(n):
-            if keys[i] is None:
-                continue  # unknown validator / in-batch repeat: invalid
+            if keys[i] is None or pending[i]:
+                continue  # unknown validator / in-batch repeat / in flight
             if cached[i] is None:
                 miss_idx.append(i)
             else:
                 valid[i] = cached[i]
         if miss_idx:
-            sub_valid = self._verify_only(
-                [msgs[i] for i in miss_idx],
-                [sigs[i] for i in miss_idx],
-                val_idx[miss_idx],
-            )
+            try:
+                sub_valid = self._verify_only(
+                    [msgs[i] for i in miss_idx],
+                    [sigs[i] for i in miss_idx],
+                    val_idx[miss_idx],
+                )
+            except BaseException:
+                # claims must not outlive a failed verify (waiters would
+                # stall until the TTL) — hand them to the next asker
+                self.cache.release_many([keys[i] for i in miss_idx])
+                raise
             self.cache.store_many(
                 [(keys[i], bool(v)) for i, v in zip(miss_idx, sub_valid)]
             )
@@ -430,13 +545,22 @@ class DeviceVoteVerifier:
             stake, tx_slot[ok], self._powers[val_idx[ok]].astype(np.int64)
         )
         q = self.val_set.quorum_power() if quorum is None else quorum
-        return TallyResult(valid, stake, stake >= q, ~keep)
+        # pending claims ride the dropped mask: the engine re-offers them
+        # next step exactly like in-batch (slot, validator) repeats
+        return TallyResult(valid, stake, stake >= q, ~keep | pending)
 
     def _verify_only(self, msgs, sigs, val_idx) -> np.ndarray:
         """Device signature verification without the tally (slots parked
         at -1, minimal slot bucket): bool[n]."""
         n = len(msgs)
-        b = bucket_size(n, self.buckets, multiple=self._n_shards)
+        # fine-grained buckets: cached-path miss sets are far smaller than
+        # engine drains (other engines own most votes via claims), and
+        # padding a ~100-miss set to a 4096-wide program wastes the whole
+        # device step (the r5 580-votes/s pathology's second half)
+        b = bucket_size(n, self.miss_buckets, multiple=self._n_shards)
+        # slot width stays on the coarse bucket ladder: the already-banked
+        # compiled programs use it, and the tally half of the program is
+        # insensitive to slot width next to the verify half
         b_slots = self.buckets[0]
         batch = ed25519_batch.prepare_compact(msgs, sigs, val_idx, self.epoch)
         pad = b - n
@@ -563,8 +687,8 @@ class VerifierMux:
             req.error = err
             req.done.set()
 
-    def warmup(self, n: int = 1) -> None:
-        self.inner.warmup(n)
+    def warmup(self, n: int = 1, full: bool = False) -> None:
+        self.inner.warmup(n, full=full)
 
     def verify_and_tally(
         self, msgs, sigs, val_idx, tx_slot, n_slots,
