@@ -389,22 +389,19 @@ def run_bench(platform: str) -> dict:
                 delay = target - time.perf_counter()
                 if delay > 0:
                     time.sleep(delay)
+            # batched seeding: one lock-group ingest per (node, chunk)
+            # instead of a lock acquire + notify per item on this thread
+            # (r5 instrumented profile: 32768 per-vote check_tx calls)
+            tx_chunk = txs[base : base + chunk_size]
             for node in net.nodes:
-                for tx in txs[base : base + chunk_size]:
-                    try:
-                        node.mempool.check_tx(tx)
-                    except Exception:
-                        pass
+                node.mempool.check_tx_many(tx_chunk)
             t_chunk = time.perf_counter()
             for vi, node in enumerate(net.nodes):
-                pool = node.tx_vote_pool
-                for vote in votes_by_val[vi][base : base + chunk_size]:
-                    if vi == 0:
+                vote_chunk = votes_by_val[vi][base : base + chunk_size]
+                if vi == 0:
+                    for vote in vote_chunk:
                         inject_t[vote.tx_hash] = t_chunk
-                    try:
-                        pool.check_tx(vote)
-                    except Exception:
-                        pass
+                node.tx_vote_pool.check_tx_many(vote_chunk)
         ok = net.wait_all_committed(txs, timeout=600.0)
         wall = time.perf_counter() - t0
         if not ok:

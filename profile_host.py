@@ -125,19 +125,11 @@ def main() -> None:
     net.start()
     t0 = time.perf_counter()
     for base in range(0, n_txs, chunk):
+        tx_chunk = txs[base : base + chunk]
         for node in net.nodes:
-            for tx in txs[base : base + chunk]:
-                try:
-                    node.mempool.check_tx(tx)
-                except Exception:
-                    pass
+            node.mempool.check_tx_many(tx_chunk)
         for vi, node in enumerate(net.nodes):
-            pool = node.tx_vote_pool
-            for vote in votes_by_val[vi][base : base + chunk]:
-                try:
-                    pool.check_tx(vote)
-                except Exception:
-                    pass
+            node.tx_vote_pool.check_tx_many(votes_by_val[vi][base : base + chunk])
     ok = net.wait_all_committed(txs, timeout=600.0)
     wall = time.perf_counter() - t0
     committed = net.committed_votes_total()

@@ -210,6 +210,10 @@ class TxFlow:
     def step(self) -> int:
         """One verify+tally+commit round; returns votes processed."""
         t0 = time.perf_counter()
+        # seq snapshot BEFORE the drain: the defer-backoff wait below must
+        # wake for votes that arrive during the (~100 ms) verify call, not
+        # only after a post-step snapshot
+        drain_seq = self.tx_vote_pool.seq()
         with self._mtx:
             raw, self._drain_cursor = self.tx_vote_pool.entries_from(
                 self._drain_cursor,
@@ -341,9 +345,10 @@ class TxFlow:
             # back off on that scale or this loop busy-spins the whole
             # step preamble (drain + sign-bytes + key build) against the
             # owner's in-flight call for nothing. A pool wait (not a
-            # sleep) so genuinely new votes still wake the engine early.
+            # sleep) against the PRE-drain seq snapshot, so votes that
+            # arrived during the verify call wake the engine immediately.
             self.tx_vote_pool.wait_for_new(
-                self.tx_vote_pool.seq(), timeout=self.config.defer_backoff
+                drain_seq, timeout=self.config.defer_backoff
             )
         return len(votes) + len(drop_now)
 
@@ -521,7 +526,10 @@ class TxFlow:
                     del self._unapplied[vs.tx_hash]
             apply_items.append((vs, tx))
         if not apply_items:
-            self._applied_count += len(items) - deferred - retired
+            with self._mtx:
+                # under _mtx: claim_vtx's locked += 1 for a different
+                # deferred tx must not be lost to this read-modify-write
+                self._applied_count += len(items) - deferred - retired
             return
         for base in range(0, len(apply_items), interval):
             group = apply_items[base : base + interval]
@@ -541,7 +549,8 @@ class TxFlow:
         self.commitpool.push_committed_many(
             [tx for _, tx in apply_items], [vs.tx_key for vs, _ in apply_items]
         )
-        self._applied_count += len(items) - deferred - retired
+        with self._mtx:  # see the early-return comment above
+            self._applied_count += len(items) - deferred - retired
 
     def commits_drained(self) -> bool:
         """True when every decided commit has been applied (the pipelined
@@ -597,7 +606,8 @@ class TxFlow:
             self.app_hash = app_hash
             self.metrics.committed_txs.add(1)
             self.commitpool.push_committed_many([tx], [tx_key])
-            self._applied_count += 1
+            with self._mtx:  # racing claim_vtx's locked increment
+                self._applied_count += 1
 
     def is_tx_committed(self, tx_hash: str) -> bool:
         """Committed via EITHER path: the fast path (TxStore certificate)

@@ -55,7 +55,7 @@ class TxInfo:
     sender_id: int = 0
 
 
-@dataclass
+@dataclass(slots=True)
 class _MempoolTx:
     height: int
     gas_wanted: int
@@ -124,49 +124,80 @@ class Mempool(IngestLogPool):
         hash (r4 profile)."""
         tx_info = tx_info or TxInfo()
         with self._mtx:
-            if (
-                len(self._txs) >= self.config.size
-                or len(tx) + self._txs_bytes > self.config.max_txs_bytes
-            ):
-                raise ErrMempoolIsFull(
-                    len(self._txs), self.config.size, self._txs_bytes, self.config.max_txs_bytes
-                )
-            if key is None:
-                key = sha256(tx)
-            if not self.cache.push(key):
-                entry = self._txs.get(key)
-                if entry is not None:
-                    entry.senders.add(tx_info.sender_id)
-                raise ErrTxInCache()
-            if self.pre_check is not None:
-                err = self.pre_check(tx)
-                if err is not None:
-                    self.cache.remove(key)
-                    raise ValueError(f"rejected by pre_check: {err}")
-            fast_path = True
-            if self.proxy_app is not None:
-                res = self.proxy_app.check_tx_sync(tx)
-                if not res.is_ok:
-                    self.cache.remove(key)
-                    raise ValueError(f"rejected by app CheckTx (code {res.code}): {res.log}")
-                gas = res.gas_wanted
-                fast_path = getattr(res, "fast_path", True)
-            else:
-                gas = 0
-            if self.post_check is not None:
-                err = self.post_check(tx)
-                if err is not None:
-                    self.cache.remove(key)
-                    raise ValueError(f"rejected by post_check: {err}")
-            if self.wal is not None and write_wal:
-                self.wal.write(tx)
-            entry = _MempoolTx(
-                self.height, gas, tx, {tx_info.sender_id}, fast_path
+            self._check_tx_locked(tx, tx_info, write_wal, key)
+
+    def check_tx_many(
+        self,
+        txs: list[bytes],
+        tx_info: TxInfo | None = None,
+        write_wal: bool = True,
+    ) -> list[Exception | None]:
+        """Batched ingest: same per-tx decisions/order as check_tx, errors
+        returned instead of raised, bounded lock holds (64-tx groups, the
+        txvotepool.check_tx_many pattern) so drains stay fair. The bench's
+        seeding loop paid a lock acquire + notify per tx on the main
+        thread (r5 instrumented profile: 32768 calls)."""
+        tx_info = tx_info or TxInfo()
+        out: list[Exception | None] = [None] * len(txs)
+        for base in range(0, len(txs), 64):
+            with self._mtx:
+                for i, tx in enumerate(txs[base : base + 64], base):
+                    try:
+                        self._check_tx_locked(tx, tx_info, write_wal, None)
+                    except Exception as e:
+                        out[i] = e
+        return out
+
+    def _check_tx_locked(
+        self,
+        tx: bytes,
+        tx_info: TxInfo,
+        write_wal: bool = True,
+        key: bytes | None = None,
+    ) -> None:
+        if (
+            len(self._txs) >= self.config.size
+            or len(tx) + self._txs_bytes > self.config.max_txs_bytes
+        ):
+            raise ErrMempoolIsFull(
+                len(self._txs), self.config.size, self._txs_bytes, self.config.max_txs_bytes
             )
-            self._txs[key] = entry
-            self._log_append(key)
-            self._txs_bytes += len(tx)
-            self._notify_txs_available()
+        if key is None:
+            key = sha256(tx)
+        if not self.cache.push(key):
+            entry = self._txs.get(key)
+            if entry is not None:
+                entry.senders.add(tx_info.sender_id)
+            raise ErrTxInCache()
+        if self.pre_check is not None:
+            err = self.pre_check(tx)
+            if err is not None:
+                self.cache.remove(key)
+                raise ValueError(f"rejected by pre_check: {err}")
+        fast_path = True
+        if self.proxy_app is not None:
+            res = self.proxy_app.check_tx_sync(tx)
+            if not res.is_ok:
+                self.cache.remove(key)
+                raise ValueError(f"rejected by app CheckTx (code {res.code}): {res.log}")
+            gas = res.gas_wanted
+            fast_path = getattr(res, "fast_path", True)
+        else:
+            gas = 0
+        if self.post_check is not None:
+            err = self.post_check(tx)
+            if err is not None:
+                self.cache.remove(key)
+                raise ValueError(f"rejected by post_check: {err}")
+        if self.wal is not None and write_wal:
+            self.wal.write(tx)
+        entry = _MempoolTx(
+            self.height, gas, tx, {tx_info.sender_id}, fast_path
+        )
+        self._txs[key] = entry
+        self._log_append(key)
+        self._txs_bytes += len(tx)
+        self._notify_txs_available()
 
     def _notify_txs_available(self) -> None:
         if self._notify_available and not self._notified_txs_available:

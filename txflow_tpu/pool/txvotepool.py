@@ -43,7 +43,7 @@ def vote_key(vote: TxVote) -> bytes:
     return vote.vote_key()  # cached on the immutable vote
 
 
-@dataclass
+@dataclass(slots=True)
 class _PoolVote:
     height: int
     vote: TxVote
@@ -230,9 +230,12 @@ class TxVotePool(IngestLogPool):
             raise ErrTxInCache()
         if self.wal is not None and write_wal:
             self.wal.write(encoded)
+        seg = vote._seg_cache
+        if seg is None:
+            seg = amino.length_prefixed(encoded)
+            object.__setattr__(vote, "_seg_cache", seg)
         entry = _PoolVote(
-            self.height, vote, {tx_info.sender_id}, vote_size,
-            seg=amino.length_prefixed(encoded),
+            self.height, vote, {tx_info.sender_id}, vote_size, seg=seg
         )
         self._votes[key] = entry
         self._log_append(key)

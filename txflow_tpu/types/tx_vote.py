@@ -81,6 +81,10 @@ class TxVote:
     )
     _wire_cache: bytes | None = field(default=None, repr=False, compare=False)
     _vk_cache: bytes | None = field(default=None, repr=False, compare=False)
+    # length-prefixed wire form (gossip frame segment): decoded votes are
+    # shared process-wide by the reactor wire cache, so caching the seg on
+    # the object makes every co-located pool's ingest reuse one build
+    _seg_cache: bytes | None = field(default=None, repr=False, compare=False)
 
     def __setattr__(self, name, value):
         # any semantic-field write invalidates the encode caches, so even
@@ -90,6 +94,7 @@ class TxVote:
             object.__setattr__(self, "_sb_cache", None)
             object.__setattr__(self, "_wire_cache", None)
             object.__setattr__(self, "_vk_cache", None)
+            object.__setattr__(self, "_seg_cache", None)
         object.__setattr__(self, name, value)
 
     def sign_bytes(self, chain_id: str) -> bytes:
@@ -146,6 +151,7 @@ class TxVote:
         oset(v, "_sb_cache", self._sb_cache)
         oset(v, "_wire_cache", self._wire_cache)
         oset(v, "_vk_cache", self._vk_cache)
+        oset(v, "_seg_cache", self._seg_cache)
         return v
 
     def vote_key(self) -> bytes:
@@ -375,6 +381,7 @@ def decode_tx_vote(data: bytes) -> TxVote:
     oset(vote, "signature", signature)
     oset(vote, "_sb_cache", None)
     oset(vote, "_vk_cache", None)
+    oset(vote, "_seg_cache", None)
     if signature and canonical and tx_key is not _ZERO_TXKEY:
         oset(vote, "_wire_cache", bytes(data))
     else:
@@ -439,6 +446,7 @@ def decode_tx_votes_many(segs: list[bytes]) -> list[TxVote]:
         oset(vote, "signature", sig)
         oset(vote, "_sb_cache", None)
         oset(vote, "_vk_cache", None)
+        oset(vote, "_seg_cache", None)
         if sig and (f & 2) and ko >= 0:
             oset(vote, "_wire_cache", seg)
         else:

@@ -1,8 +1,12 @@
 """Fixed-size LRU set (reference txvotepool ``mapTxCache``, :388-451).
 
-push() returns False when the key is already cached (the pool's dedup
-signal); at capacity the oldest entry is evicted — identical observable
-behavior to the reference's map+list implementation, via OrderedDict.
+push() returns False when the key is already cached — refreshing its
+recency, exactly like the reference's Push (list.MoveToBack before the
+false return) — and at capacity the least-recently-pushed entry is
+evicted. Implemented on a plain insertion-ordered dict (delete +
+re-insert = move-to-back): measurably cheaper per push than the previous
+OrderedDict, and the hot pools pay this once per ingest (a top-10
+host-path item at bench rates, r5 instrumented profile).
 """
 
 from __future__ import annotations
@@ -17,17 +21,19 @@ class LRUCache:
             raise ValueError("cache size must be positive")
         self.size = size
         self._mtx = threading.Lock()
-        self._map: OrderedDict[bytes, None] = OrderedDict()
+        self._map: dict[bytes, None] = {}
 
     def push(self, key: bytes) -> bool:
-        """Add key; returns False if it was already present (and refreshes it)."""
+        """Add key; False if already present (recency refreshed)."""
         with self._mtx:
-            if key in self._map:
-                self._map.move_to_end(key)
+            m = self._map
+            if key in m:
+                del m[key]  # re-insert puts it at the back (MoveToBack)
+                m[key] = None
                 return False
-            if len(self._map) >= self.size:
-                self._map.popitem(last=False)
-            self._map[key] = None
+            if len(m) >= self.size:
+                del m[next(iter(m))]
+            m[key] = None
             return True
 
     def remove(self, key: bytes) -> None:

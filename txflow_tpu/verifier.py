@@ -400,15 +400,24 @@ class DeviceVoteVerifier:
         self.verify_and_tally(
             [b""] * n, [b""] * n, np.zeros(n, np.int64), np.zeros(n, np.int64), 1
         )
-        if not full:
-            return
         if self.cache is not None:
+            # cached path: every device call is _verify_only over a miss
+            # set. Default warmup(n) keeps its documented contract — every
+            # shape an n-vote batch can hit must be warm, which on the
+            # finer miss ladder means every miss bucket up to n's coarse
+            # bucket (a smaller miss set pads to a smaller program).
+            # full=True warms the whole ladder.
+            limit = self.max_batch if full else bucket_size(n, self.buckets)
             for b in self.miss_buckets:
+                if b > limit:
+                    break
                 self._verify_only(
                     [b"warm-%d" % i for i in range(b)],
                     [b"\x00" * 64] * b,
                     np.zeros(b, np.int64),
                 )
+            return
+        if not full:
             return
         smallest = self.buckets[0]
         for b in self.buckets:
