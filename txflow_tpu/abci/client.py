@@ -32,6 +32,10 @@ class _Pending:
 class _SocketConn:
     """One ordered ABCI connection over one socket."""
 
+    # each call is a socket round trip (or a flush fence away): callers
+    # must NOT hold shared locks across call groups
+    is_local = False
+
     def __init__(self, host: str, port: int, timeout: float = 10.0):
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.settimeout(timeout)

@@ -37,6 +37,11 @@ class _Result:
 
 
 class _Conn:
+    # in-process direct calls: a CheckTx costs microseconds, so callers
+    # may hold their own locks across small call groups (pools use this
+    # to pick a batched vs per-call ingest strategy)
+    is_local = True
+
     def __init__(self, app: Application, lock: threading.RLock):
         self._app = app
         self._lock = lock

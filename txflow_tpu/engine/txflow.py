@@ -39,7 +39,7 @@ from ..pool.txvotepool import TxVotePool
 from ..store.tx_store import TxStore
 from ..types import TxVote, TxVoteSet
 from ..types.validator import ValidatorSet
-from ..utils.cache import LRUCache
+from ..utils.cache import LRUCache, UnlockedLRUCache
 from ..utils.config import EngineConfig
 from ..utils.metrics import TxFlowMetrics
 from ..verifier import DeviceVoteVerifier, ScalarVoteVerifier
@@ -88,7 +88,7 @@ class TxFlow:
             getattr(self.verifier, "max_batch", self.config.max_batch),
         )
         self.vote_sets: dict[str, TxVoteSet] = {}  # in-flight only
-        self._committed = LRUCache(1 << 16)  # recently committed tx hashes
+        self._committed = UnlockedLRUCache(1 << 16)  # recently committed tx hashes
         # ingest-log cursor: each pool entry is visited by step() exactly
         # once via the stable-cursor walk (in-batch repeats re-queue on
         # _retry). The previous skip-set drain re-walked EVERY live pool

@@ -45,6 +45,18 @@ class IngestLogPool:
         self._seq += 1
         self._cond.notify_all()
 
+    def _log_append_quiet(self, key: bytes) -> None:
+        """Append WITHOUT waking waiters — batch ingest paths append a
+        whole lock-group and then call _log_notify once (a notify_all per
+        vote measured as ~1/3 of the ingest cost, r5 microbench). Callers
+        MUST follow with _log_notify before releasing the lock, or
+        waiters sleep a full poll interval past available work."""
+        self._log.append(key)
+        self._seq += 1
+
+    def _log_notify(self) -> None:
+        self._cond.notify_all()
+
     def _log_compact(self) -> None:
         """Drop the longest dead prefix once it crosses the threshold.
 

@@ -17,7 +17,7 @@ import threading
 from dataclasses import dataclass, field
 
 from ..crypto.hash import sha256
-from ..utils.cache import LRUCache, NopCache
+from ..utils.cache import LRUCache, NopCache, UnlockedLRUCache
 from ..utils.config import MempoolConfig
 from ..utils.wal import WAL
 from .base import IngestLogPool
@@ -82,7 +82,7 @@ class Mempool(IngestLogPool):
         self.post_check = post_check
         self._txs: dict[bytes, _MempoolTx] = self._items  # tx_key -> entry
         self._txs_bytes = 0
-        self.cache = LRUCache(config.cache_size) if config.cache_size > 0 else NopCache()
+        self.cache = UnlockedLRUCache(config.cache_size) if config.cache_size > 0 else NopCache()
         self._txs_available = threading.Event()
         self._notified_txs_available = False
         self._notify_available = False
@@ -136,16 +136,44 @@ class Mempool(IngestLogPool):
         returned instead of raised, bounded lock holds (64-tx groups, the
         txvotepool.check_tx_many pattern) so drains stay fair. The bench's
         seeding loop paid a lock acquire + notify per tx on the main
-        thread (r5 instrumented profile: 32768 calls)."""
+        thread (r5 instrumented profile: 32768 calls).
+
+        Only a LOCAL (in-process) app conn may sit inside the lock
+        groups — its CheckTx costs microseconds. Over a socket conn each
+        CheckTx is a round trip, so 64 of them under one pool-lock hold
+        would starve reap/drain/update for tens of ms (r5 review): that
+        case falls back to the per-tx path, which releases the lock
+        between app calls."""
         tx_info = tx_info or TxInfo()
         out: list[Exception | None] = [None] * len(txs)
+        if self.proxy_app is not None and not getattr(
+            self.proxy_app, "is_local", False
+        ):
+            for i, tx in enumerate(txs):
+                try:
+                    self.check_tx(tx, tx_info, write_wal)
+                except Exception as e:
+                    out[i] = e
+            return out
         for base in range(0, len(txs), 64):
+            accepted = False
             with self._mtx:
                 for i, tx in enumerate(txs[base : base + 64], base):
                     try:
-                        self._check_tx_locked(tx, tx_info, write_wal, None)
+                        self._check_tx_locked(
+                            tx, tx_info, write_wal, None, notify=False
+                        )
+                        accepted = True
                     except Exception as e:
                         out[i] = e
+                # one waiter wakeup per lock group, not per tx (the
+                # votepool batch path's pattern; a notify_all per item
+                # measured ~1/3 of ingest cost, r5 microbench) — and only
+                # when the group actually accepted something (an all-dup
+                # group on an empty pool must not wake the proposer)
+                if accepted:
+                    self._log_notify()
+                    self._notify_txs_available()
         return out
 
     def _check_tx_locked(
@@ -154,6 +182,7 @@ class Mempool(IngestLogPool):
         tx_info: TxInfo,
         write_wal: bool = True,
         key: bytes | None = None,
+        notify: bool = True,
     ) -> None:
         if (
             len(self._txs) >= self.config.size
@@ -195,9 +224,13 @@ class Mempool(IngestLogPool):
             self.height, gas, tx, {tx_info.sender_id}, fast_path
         )
         self._txs[key] = entry
-        self._log_append(key)
+        if notify:
+            self._log_append(key)
+        else:
+            self._log_append_quiet(key)  # caller notifies per group
         self._txs_bytes += len(tx)
-        self._notify_txs_available()
+        if notify:
+            self._notify_txs_available()
 
     def _notify_txs_available(self) -> None:
         if self._notify_available and not self._notified_txs_available:

@@ -25,7 +25,7 @@ from dataclasses import dataclass, field
 from ..codec import amino
 from ..crypto.hash import sha256
 from ..types import TxVote, decode_tx_vote, encode_tx_vote
-from ..utils.cache import LRUCache, NopCache
+from ..utils.cache import LRUCache, NopCache, UnlockedLRUCache
 from ..utils.config import MempoolConfig
 from ..utils.wal import WAL
 from .base import IngestLogPool
@@ -62,7 +62,7 @@ class TxVotePool(IngestLogPool):
         self.height = height
         self._votes: dict[bytes, _PoolVote] = self._items  # vote_key -> entry
         self._votes_bytes = 0
-        self.cache = LRUCache(config.cache_size) if config.cache_size > 0 else NopCache()
+        self.cache = UnlockedLRUCache(config.cache_size) if config.cache_size > 0 else NopCache()
         self._txs_available = threading.Event()
         self._notified_txs_available = False
         self._notify_available = False
@@ -174,29 +174,82 @@ class TxVotePool(IngestLogPool):
         write_wal: bool = True,
     ) -> list[Exception | None]:
         """Frame-batched ingest: per-vote acceptance decisions identical
-        to check_tx (same order, same errors — returned, not raised), but
-        serialization happens OUTSIDE the pool lock and the lock is taken
-        once for the whole frame. The gossip receive path hands a frame's
-        votes here; per-vote lock churn on the hot pool measured 62 µs/
-        vote under bench contention (r5 instrumented profile)."""
+        to check_tx (same order, same errors — returned, not raised),
+        with bounded lock holds (64-vote groups) and one waiter wakeup
+        per group. Encode/hash for cache-miss votes runs inside the lock
+        group — in the gossip path those caches are always primed at
+        decode, so the in-lock work is dict stores and accounting; only
+        locally constructed votes pay an in-lock encode (~1 us each,
+        r5 microbench: the out-of-lock prepped-tuple design cost more in
+        packaging than it saved in lock width)."""
         tx_info = tx_info or TxInfo(UNKNOWN_PEER_ID)
-        prepped = [(v, encode_tx_vote(v), vote_key(v)) for v in votes]
         out: list[Exception | None] = [None] * len(votes)
+        # Inlined non-raising twin of _ingest_locked (keep the two in
+        # sync): the wrapper-per-vote form — prepped tuples, try/except,
+        # enumerate — measured 5.7 us/vote against the core's 4.4
+        # (r5 microbench), i.e. more than half the ingest cost was
+        # packaging. Error objects are built only on actual rejection.
+        sid = tx_info.sender_id
+        cfg = self.config
+        max_size = cfg.max_msg_bytes - _MSG_OVERHEAD
+        cache_push = self.cache.push
+        votes_d = self._votes
+        log_append = self._log_append_quiet  # one _log_notify per group
+        wal = self.wal if write_wal else None
+        oset = object.__setattr__
+        new = _PoolVote.__new__
         # bounded lock holds: a whole gossip frame under one lock starved
         # the drain/purge/inject paths for milliseconds (r5 instrumented
         # profile) — 64 votes ≈ a few hundred µs, keeping the pool fair
-        for base in range(0, len(prepped), 64):
+        for base in range(0, len(votes), 64):
+            accepted = False
             with self._mtx:
-                for i, (vote, encoded, key) in enumerate(
-                    prepped[base : base + 64], base
-                ):
-                    try:
-                        self._ingest_locked(
-                            vote, encoded, key, tx_info, write_wal
+                for i in range(base, min(base + 64, len(votes))):
+                    vote = votes[i]
+                    encoded = vote._wire_cache
+                    if encoded is None:
+                        encoded = encode_tx_vote(vote)
+                    vote_size = len(encoded)
+                    if (
+                        len(votes_d) >= cfg.size
+                        or vote_size + self._votes_bytes > cfg.max_txs_bytes
+                    ):
+                        out[i] = ErrMempoolIsFull(
+                            len(votes_d), cfg.size,
+                            self._votes_bytes, cfg.max_txs_bytes,
                         )
-                    except (ErrMempoolIsFull, ErrTxInCache, ErrTxTooLarge) as e:
-                        out[i] = e
-                self._notify_txs_available()
+                        continue
+                    if vote_size > max_size:
+                        out[i] = ErrTxTooLarge(max_size, vote_size)
+                        continue
+                    key = vote._vk_cache
+                    if key is None:
+                        key = vote.vote_key()
+                    if not cache_push(key):
+                        entry = votes_d.get(key)
+                        if entry is not None:
+                            entry.senders.add(sid)
+                        out[i] = ErrTxInCache()
+                        continue
+                    if wal is not None:
+                        wal.write(encoded)
+                    seg = vote._seg_cache
+                    if seg is None:
+                        seg = amino.length_prefixed(encoded)
+                        oset(vote, "_seg_cache", seg)
+                    entry = new(_PoolVote)
+                    entry.height = self.height
+                    entry.vote = vote
+                    entry.senders = {sid}
+                    entry.size = vote_size
+                    entry.seg = seg
+                    votes_d[key] = entry
+                    log_append(key)
+                    self._votes_bytes += vote_size
+                    accepted = True
+                if accepted:  # an all-dup group must not wake consumers
+                    self._log_notify()
+                    self._notify_txs_available()
         return out
 
     def _ingest_locked(

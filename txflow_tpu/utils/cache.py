@@ -53,6 +53,46 @@ class LRUCache:
             return len(self._map)
 
 
+class UnlockedLRUCache:
+    """LRUCache without the internal lock, for owners that already
+    serialize every MUTATION under their own mutex (both pools mutate
+    their dedup caches exclusively under the pool lock; the engine's
+    committed-set under the engine lock). Lock-free READS (``in``) from
+    other threads stay safe: membership tests on a plain dict never
+    observe torn state under the GIL, and the reactor's in_cache peek
+    tolerates stale answers by falling back to the authoritative
+    check_tx path."""
+
+    def __init__(self, size: int):
+        if size <= 0:
+            raise ValueError("cache size must be positive")
+        self.size = size
+        self._map: dict[bytes, None] = {}
+
+    def push(self, key: bytes) -> bool:
+        m = self._map
+        if key in m:
+            del m[key]  # re-insert = MoveToBack (reference mapTxCache)
+            m[key] = None
+            return False
+        if len(m) >= self.size:
+            del m[next(iter(m))]
+        m[key] = None
+        return True
+
+    def remove(self, key: bytes) -> None:
+        self._map.pop(key, None)
+
+    def reset(self) -> None:
+        self._map.clear()
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self._map
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+
 class NopCache:
     """Cache disabled (config.cache_size = 0): everything is new."""
 
