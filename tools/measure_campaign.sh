@@ -26,21 +26,24 @@ run() { # name, extra env as VAR=VAL...
   else
     echo "--- $name FAILED rc=$? (stderr tail below)" >&2
     tail -5 "$ART/$name.stderr" >&2
+    # never leave a stale prior .json (or the partial .tmp) posing as
+    # this campaign's output
+    rm -f "$ART/$name.json" "$ART/$name.tmp"
   fi
 }
 
 # config 4: adversarial mix (25% corrupted votes; bench asserts zero
 # corrupted votes land in certificates)
-run tpu_byzantine_config4 BENCH_BYZANTINE=0.25 BENCH_LATENCY_SWEEP=0
+run ${PLAT}_byzantine_config4 BENCH_BYZANTINE=0.25 BENCH_LATENCY_SWEEP=0
 
 # config 5: consensus ticker ON alongside the fast path (target >= 80%
 # of config 1 after the r5 interference fixes)
-run tpu_consensus_config5_r5 BENCH_CONSENSUS=1 BENCH_LATENCY_SWEEP=0
+run ${PLAT}_consensus_config5_r5 BENCH_CONSENSUS=1 BENCH_LATENCY_SWEEP=0
 
 # config 2: 16 validators (fresh [V,16,4,32] table shape -> new compile)
-run tpu_16val_config2 BENCH_VALIDATORS=16 BENCH_LATENCY_SWEEP=0
+run ${PLAT}_16val_config2 BENCH_VALIDATORS=16 BENCH_LATENCY_SWEEP=0
 
 # config 3: 64 validators
-run tpu_64val_config3 BENCH_VALIDATORS=64 BENCH_LATENCY_SWEEP=0
+run ${PLAT}_64val_config3 BENCH_VALIDATORS=64 BENCH_LATENCY_SWEEP=0
 
 echo "campaign complete $(date -u +%H:%M:%S)" >&2
