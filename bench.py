@@ -313,9 +313,20 @@ def run_bench(platform: str) -> dict:
     # 2-3 axes — [V] epoch-table gather, 2/3-of-64 quorum math, votes/tx
     # volume — without co-locating 64 full-mesh nodes in one process
     # (~4k threads on one core: the r5 64-val run never finished).
-    n_nodes = int(os.environ.get("BENCH_NODES", str(min(n_vals, 4))))
-    if not 1 <= n_nodes <= n_vals:
-        raise ValueError(f"BENCH_NODES must be in [1, {n_vals}], got {n_nodes}")
+    # consensus-enabled runs default to hosting EVERY validator: the
+    # block path needs 2/3 of the consensus voters present
+    default_nodes = n_vals if with_consensus else min(n_vals, 4)
+    n_nodes = int(os.environ.get("BENCH_NODES", str(default_nodes)))
+    if with_consensus and n_nodes < n_vals:
+        # the block path needs 2/3 of the CONSENSUS voters hosted; with a
+        # 4-of-16 subset blocks can never commit and the run would
+        # silently measure zero consensus interference (config 5's whole
+        # point). Host every validator for consensus-enabled runs.
+        raise ValueError(
+            f"BENCH_CONSENSUS=1 requires hosting all {n_vals} validators "
+            f"(BENCH_NODES={n_nodes}): a hosted subset cannot reach block "
+            "quorum"
+        )
     net = LocalNet(
         n_vals,
         chain_id="txflow-bench",
@@ -656,6 +667,7 @@ def main():
         and os.environ.get("BENCH_VALIDATORS", "4") == "4"
         and os.environ.get("BENCH_CONSENSUS", "0") != "1"
         and float(os.environ.get("BENCH_BYZANTINE", "0")) == 0
+        and os.environ.get("BENCH_NODES") is None
     ):
         # only the DEFAULT config banks: the no-cache companion and the
         # 16/64-validator / consensus-on sweep runs must never overwrite

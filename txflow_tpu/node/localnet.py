@@ -70,6 +70,10 @@ class LocalNet:
         )
         cfg = config or test_config()
         self.nodes: list[Node] = []
+        if n_nodes is not None and not 1 <= n_nodes <= len(priv_vals):
+            raise ValueError(
+                f"n_nodes must be in [1, {len(priv_vals)}], got {n_nodes}"
+            )
         hosted = priv_vals if n_nodes is None else priv_vals[:n_nodes]
         for i, pv in enumerate(hosted):
             node = Node(
