@@ -314,7 +314,16 @@ def run_bench(platform: str) -> dict:
     # volume — without co-locating 64 full-mesh nodes in one process
     # (~4k threads on one core: the r5 64-val run never finished).
     # consensus-enabled runs default to hosting EVERY validator: the
-    # block path needs 2/3 of the consensus voters present
+    # block path needs 2/3 of the consensus voters present. That caps how
+    # large a consensus bench can be — co-locating tens of full-mesh
+    # nodes in one process measures thread thrash, not the protocol (the
+    # 64-node r5 run never finished) — so fail fast instead of hanging.
+    if with_consensus and n_vals > 8:
+        raise ValueError(
+            f"BENCH_CONSENSUS=1 hosts all {n_vals} validators as full "
+            "in-process nodes; beyond 8 that topology thrashes one host "
+            "(use <= 8 validators for consensus-enabled runs)"
+        )
     default_nodes = n_vals if with_consensus else min(n_vals, 4)
     n_nodes = int(os.environ.get("BENCH_NODES", str(default_nodes)))
     if with_consensus and n_nodes < n_vals:

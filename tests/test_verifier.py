@@ -587,3 +587,39 @@ def VerifyCache_key_for(verifier, msg, sig, vi):
     from txflow_tpu.verifier import VerifyCache
 
     return VerifyCache.key(msg, sig, verifier._pub_keys[vi])
+
+
+def test_warmup_full_compiles_every_reachable_shape(valset4):
+    """warmup(full=True) must exercise _verify_only at EVERY miss bucket
+    (cached path) — a shape left cold compiles mid-measurement on the
+    first batch that hits it (r5: a 169 s throughput phase was ~160 s of
+    one such compile)."""
+    from txflow_tpu.verifier import VerifyCache
+
+    vals, _seeds = valset4
+    dev = DeviceVoteVerifier(vals, buckets=(64, 256), shared_cache=VerifyCache())
+    seen: list[int] = []
+    orig = dev._verify_only
+
+    def spy(msgs, sigs, val_idx):
+        seen.append(len(msgs))
+        return orig(msgs, sigs, val_idx)
+
+    dev._verify_only = spy
+    dev.warmup(full=True)
+    assert set(seen) >= set(dev.miss_buckets), (seen, dev.miss_buckets)
+
+    # default warmup(n) keeps its contract: every shape an n-vote batch
+    # can hit is warm — all miss buckets up to n's coarse bucket
+    dev2 = DeviceVoteVerifier(vals, buckets=(64, 256), shared_cache=VerifyCache())
+    seen2: list[int] = []
+    orig2 = dev2._verify_only
+
+    def spy2(msgs, sigs, val_idx):
+        seen2.append(len(msgs))
+        return orig2(msgs, sigs, val_idx)
+
+    dev2._verify_only = spy2
+    dev2.warmup(256)
+    want = {b for b in dev2.miss_buckets if b <= 256}
+    assert set(seen2) >= want, (seen2, want)
