@@ -461,6 +461,39 @@ def run_bench(platform: str) -> dict:
     committed = net.committed_votes_total() - warm_committed
     votes_per_sec = committed / wall
 
+    # Residual-compile guard (r5 postmortem: a 169 s phase 1 contained
+    # ~160 s of ONE remote kernel compile for a shape the warmup missed,
+    # and the contaminated 580-votes/s headline got banked). End-to-end
+    # throughput can be host-bound to a fraction of the device-step rate,
+    # but a result BELOW device_step/5 is not a steady state this
+    # pipeline can produce — by then the compile is banked in the
+    # persistent cache, so one rerun with a fresh corpus measures clean.
+    phase1_rerun = False
+    first_pass_votes_per_sec = votes_per_sec
+    audit_corpora = [main_corpus]
+    if (
+        verifier_kind == "device"
+        and device_step_votes_per_sec > 0
+        and votes_per_sec < device_step_votes_per_sec / 5
+    ):
+        print(
+            f"bench: phase 1 at {votes_per_sec:.0f} votes/s << device step "
+            f"{device_step_votes_per_sec:.0f} — suspected in-run compile; "
+            "re-measuring once",
+            file=sys.stderr,
+        )
+        rerun_corpus = make_corpus("rerun", n_txs)
+        audit_corpora.append(rerun_corpus)
+        before = net.committed_votes_total()
+        wall2, _ = seed_and_replay(*rerun_corpus, chunk)
+        committed2 = net.committed_votes_total() - before
+        rerun_votes_per_sec = committed2 / wall2
+        phase1_rerun = True
+        if rerun_votes_per_sec > 2 * votes_per_sec:
+            # materially faster warm rerun CONFIRMS the compile theory:
+            # report the warm steady state as the headline
+            committed, wall, votes_per_sec = committed2, wall2, rerun_votes_per_sec
+
     # phase 2 — LATENCY: a smaller corpus offered at ~60% of measured
     # capacity, in small chunks, so p50 reflects pipeline service time.
     # The pacing axis must match the capacity axis: seed_and_replay paces
@@ -520,6 +553,17 @@ def run_bench(platform: str) -> dict:
     }
     if verifier_kind == "device":
         result["device_step_votes_per_sec"] = device_step_votes_per_sec
+    if phase1_rerun:
+        # both passes recorded: a reader must be able to tell a CONFIRMED
+        # compile (rerun much faster -> rerun is the headline) from a
+        # genuine bottleneck (rerun similar -> FIRST pass stays headline)
+        result["phase1_first_pass_votes_per_sec"] = round(
+            first_pass_votes_per_sec, 1
+        )
+        result["phase1_rerun_votes_per_sec"] = round(rerun_votes_per_sec, 1)
+        result["phase1_compile_confirmed"] = (
+            rerun_votes_per_sec > 2 * first_pass_votes_per_sec
+        )
     if byz_frac > 0:
         result["byzantine_fraction"] = byz_frac
         byz_addr = net.priv_vals[0].get_address()
@@ -527,8 +571,9 @@ def run_bench(platform: str) -> dict:
         # honest vote for a corrupted slot was never injected, so its
         # address simply must be absent from those txs' certificates
         bad = 0
+        audit_txs = [tx for corpus in audit_corpora for tx in corpus[0]]
         for node in net.nodes:
-            for t_i, tx in enumerate(main_corpus[0]):
+            for t_i, tx in enumerate(audit_txs):
                 if (t_i % 100) < byz_frac * 100:
                     votes = node.tx_store.load_tx_votes(
                         hashlib.sha256(tx).hexdigest().upper()
