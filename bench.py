@@ -571,9 +571,14 @@ def run_bench(platform: str) -> dict:
         # honest vote for a corrupted slot was never injected, so its
         # address simply must be absent from those txs' certificates
         bad = 0
-        audit_txs = [tx for corpus in audit_corpora for tx in corpus[0]]
+        # per-corpus enumerate: make_corpus corrupts by each tx's index
+        # WITHIN ITS OWN corpus — a concatenated walk would audit honest
+        # slots (spurious failure) and skip corrupted ones (r5 review)
+        audit_txs = [
+            (t_i, tx) for corpus in audit_corpora for t_i, tx in enumerate(corpus[0])
+        ]
         for node in net.nodes:
-            for t_i, tx in enumerate(audit_txs):
+            for t_i, tx in audit_txs:
                 if (t_i % 100) < byz_frac * 100:
                     votes = node.tx_store.load_tx_votes(
                         hashlib.sha256(tx).hexdigest().upper()

@@ -377,3 +377,47 @@ def test_node_restart_rejoins_and_converges(tmp_path):
                 n.stop()
             except Exception:
                 pass
+
+
+def test_wal_backlog_larger_than_queue_does_not_deadlock_start(tmp_path):
+    """One height's WAL can hold more messages than the consensus queue's
+    capacity; start() must replay them synchronously (the reference's
+    catchupReplay shape) instead of enqueueing into a queue nobody drains
+    yet — a 300 s churn soak wedged node revival exactly there (r5)."""
+    import queue as _q
+    import threading
+
+    node, pv = build_node(tmp_path, enable_consensus=True)
+    node.start()
+    assert wait_until(lambda: node.consensus.state.last_block_height >= 1, 20)
+    node.stop()
+
+    # stuff the restart WAL with a same-height vote backlog
+    node2, pv2 = build_node(tmp_path, enable_consensus=True)
+    cs = node2.consensus  # the ConsensusState
+    h = cs.state.last_block_height
+    wal = cs.wal
+    from txflow_tpu.types.block_vote import PREVOTE, BlockVote
+
+    for i in range(32):
+        v = BlockVote(
+            height=h + 1,
+            round=0,
+            type=PREVOTE,
+            block_id=b"\x11" * 32,
+            timestamp_ns=1700000000_000000000 + i,
+            validator_address=pv2.get_address(),
+        )
+        pv2.sign_block_vote(CHAIN_ID, v)
+        wal.write_vote(v)
+    backlog = wal.messages_after_end_height(h)
+    assert len(backlog) > 4, "need a real backlog for the regression"
+    cs._queue = _q.Queue(maxsize=4)  # far smaller than the backlog
+
+    done = threading.Event()
+    t = threading.Thread(target=lambda: (node2.start(), done.set()), daemon=True)
+    t.start()
+    assert done.wait(30), (
+        "start() deadlocked replaying a WAL backlog larger than the queue"
+    )
+    node2.stop()

@@ -125,12 +125,18 @@ class ConsensusState:
                 target=self._txs_watcher, name="consensus-txs", daemon=True
             )
             t.start()
-        # catchup replay of the current height's WAL messages (:296-321)
+        # catchup replay of the current height's WAL messages (:296-321),
+        # processed SYNCHRONOUSLY like the reference's catchupReplay
+        # (consensus/replay.go:48-101 re-feeds into the handler before
+        # the receive routine consumes anything live). Queueing them
+        # instead deadlocked start(): the bounded queue has no consumer
+        # yet, and one height's WAL backlog can exceed its capacity
+        # (r5 soak: a 300 s churn run wedged node revival exactly here).
         if self.wal is not None:
             for kind, payload in self.wal.messages_after_end_height(
                 self.state.last_block_height
             ):
-                self._queue.put(("replay_" + kind, payload))
+                self._process("replay_" + kind, payload, replay=True)
         self._thread = threading.Thread(
             target=self._receive_routine, name="consensus", daemon=True
         )
@@ -248,22 +254,38 @@ class ConsensusState:
                 continue
             if kind == "quit":
                 return
+            self._process(kind, payload)
+
+    def _process(self, kind: str, payload, replay: bool = False) -> None:
+        """Handle ONE message plus the reinject drain it may release —
+        the shared body of the receive routine and of start()'s
+        synchronous WAL catchup replay (reference catchupReplay,
+        consensus/replay.go:48-101). A bad message must not kill
+        consensus (or boot: a torn WAL tail replays as garbage).
+
+        replay=True drains reinjected votes with replay semantics: those
+        votes were just read FROM the WAL, and the live "vote" branch
+        would append each back — one duplicate per restart, a WAL that
+        grows with restart count (r5 review)."""
+        try:
+            self._handle(kind, payload)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+        # buffered future votes released by a height change: processed
+        # here at top level, exactly like fresh arrivals
+        while self._reinject:
+            vote, peer = self._reinject.pop(0)
             try:
-                self._handle(kind, payload)
-            except Exception:  # a bad peer msg must not kill consensus
+                if replay:  # replay_vote takes the bare vote, no WAL write
+                    self._handle("replay_vote", vote)
+                else:
+                    self._handle("vote", (vote, peer))
+            except Exception:
                 import traceback
 
                 traceback.print_exc()
-            # buffered future votes released by a height change: processed
-            # here at top level, exactly like fresh arrivals
-            while self._reinject:
-                vote, peer = self._reinject.pop(0)
-                try:
-                    self._handle("vote", (vote, peer))
-                except Exception:
-                    import traceback
-
-                    traceback.print_exc()
 
     def _handle(self, kind: str, payload) -> None:
         with self._mtx:
