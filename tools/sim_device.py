@@ -1,0 +1,236 @@
+"""Simulate the tunneled-TPU device cost model on CPU (dev tool).
+
+Validates the shared-VerifyCache claim design against the measured device
+economics WITHOUT the tunnel: every verify call pays the r5-measured cost
+shape — a fixed per-call latency plus a per-PADDED-slot cost, padded on
+the same miss-bucket ladder DeviceVoteVerifier derives from its engine
+buckets — while verification itself is instant (signatures accepted for
+known validators, like profile_host's instant verifier). The bill is
+paid BETWEEN verify and store, exactly where real hardware pays it, so
+deferred engines wait out the owner's device call before their retry
+hits. One module-global lock serializes charges: one physical chip.
+
+Run A: four engines share ONE cache with claims (the bench default).
+Run B: no cache — each node pays the device for every vote (the honest
+baseline config, and the reference's topology).
+
+Measured-economics defaults: ~8 ms fixed per call; ~27.6 us per padded
+slot at bucket 4096 (bench device-step 24,433 votes/s all-in).
+r5 sim result (4096 txs, serialized device):
+  shared-cache+claims  ~22.2k votes/s  (host-bound: device busy 1.0 s
+                        of 2.2 s wall; 30.7k padded slots for 16.4k
+                        unique votes)
+  no-cache             ~10.4k votes/s  (device-bound: 4.4 s busy of
+                        4.7 s wall; 154.6k padded slots = 4x redundancy
+                        x padding) — matching the tunnel-measured
+                        value_no_shared_cache of 12.0k.
+
+Usage: JAX_PLATFORMS=cpu python tools/sim_device.py [--fixed-ms 8]
+       [--per-slot-us 27.6] [--txs 4096]
+"""
+
+import argparse
+import hashlib
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from txflow_tpu.node import LocalNet
+from txflow_tpu.types import MockPV, TxVote, Validator, ValidatorSet
+from txflow_tpu.utils.config import test_config
+from txflow_tpu.verifier import (
+    ScalarVoteVerifier,
+    TallyResult,
+    VerifyCache,
+    bucket_size,
+    first_occurrence_mask,
+)
+
+_DEVICE_LOCK = threading.Lock()
+
+
+class SimDeviceVerifier(ScalarVoteVerifier):
+    """Instant-accept verifier charging the device bill per call.
+
+    Reimplements both verify paths (fused and cached) instead of
+    patching the parent's crypto: validity is simply "known validator"
+    (the sim's corpus is all-honest), and the cached path inserts the
+    device charge between claim and store — the point where real
+    hardware holds the claims while the kernel runs."""
+
+    def __init__(self, val_set, shared_cache=None, fixed_s=0.008,
+                 per_slot_s=27.6e-6, buckets=(4096, 16384)):
+        super().__init__(val_set, shared_cache=shared_cache)
+        self._fixed_s = fixed_s
+        self._per_slot_s = per_slot_s
+        self.buckets = buckets
+        # the device's own miss ladder derivation (verifier.py
+        # DeviceVoteVerifier.__init__) — bench pair (4096, 16384)
+        # yields (256, 1024, 4096, 16384)
+        self.miss_buckets = tuple(
+            sorted(
+                {max(64, b // 16) for b in buckets}
+                | {max(64, b // 4) for b in buckets}
+                | set(buckets)
+            )
+        )
+        self.device_calls = 0
+        self.device_slots = 0
+
+    def _charge(self, n: int, ladder) -> None:
+        if n == 0:
+            return
+        b = bucket_size(n, ladder)
+        # one physical chip: concurrent callers serialize; counters are
+        # shared across engine threads, so they mutate under the lock
+        with _DEVICE_LOCK:
+            self.device_calls += 1
+            self.device_slots += b
+            time.sleep(self._fixed_s + b * self._per_slot_s)
+
+    def _validity(self, val_idx, keep) -> np.ndarray:
+        n_vals = len(self._pub_keys)
+        return keep & (val_idx >= 0) & (val_idx < n_vals)
+
+    def verify_and_tally(self, msgs, sigs, val_idx, tx_slot, n_slots,
+                         prior_stake=None, quorum=None):
+        n = len(msgs)
+        val_idx = np.asarray(val_idx, dtype=np.int64)
+        tx_slot = np.asarray(tx_slot, dtype=np.int64)
+        keep = first_occurrence_mask(tx_slot, val_idx)
+        pending = np.zeros(n, dtype=bool)
+        if self.cache is None:
+            # fused path: the whole batch pads to the engine bucket
+            self._charge(n, self.buckets)
+            valid = self._validity(val_idx, keep)
+        else:
+            n_vals = len(self._pub_keys)
+            keys = [
+                VerifyCache.key(msgs[i], sigs[i], self._pub_keys[int(val_idx[i])])
+                if keep[i] and 0 <= val_idx[i] < n_vals
+                else None
+                for i in range(n)
+            ]
+            cached, pending = self.cache.lookup_or_claim_many(keys)
+            valid = np.zeros(n, dtype=bool)
+            owned = []
+            for i in range(n):
+                if keys[i] is None or pending[i]:
+                    continue
+                if cached[i] is not None:
+                    valid[i] = cached[i]
+                else:
+                    owned.append(i)
+            if owned:
+                verdicts = self._validity(
+                    val_idx[owned], np.ones(len(owned), dtype=bool)
+                )
+                # the device runs HERE, claims held; deferred engines
+                # cannot hit until the store below
+                self._charge(len(owned), self.miss_buckets)
+                self.cache.store_many(
+                    [(keys[i], bool(v)) for i, v in zip(owned, verdicts)]
+                )
+                valid[owned] = verdicts
+        stake = (
+            np.zeros(n_slots, dtype=np.int64)
+            if prior_stake is None
+            else np.asarray(prior_stake, dtype=np.int64).copy()
+        )
+        ok = valid & (tx_slot >= 0) & (tx_slot < n_slots)
+        np.add.at(stake, tx_slot[ok], self._powers[val_idx[ok]].astype(np.int64))
+        q = self.val_set.quorum_power() if quorum is None else quorum
+        return TallyResult(valid, stake, stake >= q, ~keep | pending)
+
+
+def run(shared: bool, n_txs: int, fixed_s: float, per_slot_s: float) -> dict:
+    n_vals = 4
+    pvs = [MockPV(hashlib.sha256(b"sim%d" % i).digest()) for i in range(n_vals)]
+    by_addr = {pv.get_address(): pv for pv in pvs}
+    val_set = ValidatorSet(
+        [Validator.from_pub_key(pv.get_pub_key(), 10) for pv in pvs]
+    )
+    pvs = [by_addr[v.address] for v in val_set.validators]
+    cfg = test_config()
+    cfg.mempool.size = 16 * n_txs * (n_vals + 1)
+    cfg.mempool.cache_size = 2 * cfg.mempool.size
+    cfg.engine.min_batch = 3072
+    cfg.engine.batch_wait = 0.05
+
+    verifiers = []
+    cache = VerifyCache() if shared else None
+
+    def mk():
+        v = SimDeviceVerifier(
+            val_set, shared_cache=cache, fixed_s=fixed_s, per_slot_s=per_slot_s
+        )
+        verifiers.append(v)
+        return v
+
+    if shared:
+        net = LocalNet(4, chain_id="sim", config=cfg, use_device_verifier=False,
+                       sign=False, mempool_broadcast=False, priv_vals=pvs,
+                       verifier=mk(), index_txs=False)
+    else:
+        net = LocalNet(4, chain_id="sim", config=cfg, use_device_verifier=False,
+                       sign=False, mempool_broadcast=False, priv_vals=pvs,
+                       index_txs=False)
+        for nd in net.nodes:  # per-node device bill, no cache
+            nd.txflow.verifier = mk()
+
+    txs = [b"sim%d=v" % i for i in range(n_txs)]
+    votes_by_val = [[] for _ in range(n_vals)]
+    for tx in txs:
+        k = hashlib.sha256(tx).digest()
+        for vi, pv in enumerate(pvs):
+            v = TxVote(height=0, tx_hash=k.hex().upper(), tx_key=k,
+                       validator_address=pv.get_address())
+            pv.sign_tx_vote("sim", v)
+            votes_by_val[vi].append(v)
+    net.start()
+    try:
+        t0 = time.perf_counter()
+        chunk = 2048
+        for base in range(0, n_txs, chunk):
+            tx_chunk = txs[base:base + chunk]
+            for nd in net.nodes:
+                nd.mempool.check_tx_many(tx_chunk)
+            for vi, nd in enumerate(net.nodes):
+                nd.tx_vote_pool.check_tx_many(votes_by_val[vi][base:base + chunk])
+        ok = net.wait_all_committed(txs, timeout=600)
+        wall = time.perf_counter() - t0
+        committed = net.committed_votes_total()
+        assert ok, "sim run timed out"
+    finally:
+        net.stop()
+    return {
+        "votes_per_sec": round(committed / wall, 1),
+        "wall_s": round(wall, 2),
+        "device_calls": sum(v.device_calls for v in verifiers),
+        "device_slots": sum(v.device_slots for v in verifiers),
+        "device_busy_s": round(sum(
+            v.device_calls * fixed_s + v.device_slots * per_slot_s
+            for v in verifiers), 2),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fixed-ms", type=float, default=8.0)
+    ap.add_argument("--per-slot-us", type=float, default=27.6)
+    ap.add_argument("--txs", type=int, default=4096)
+    args = ap.parse_args()
+    for shared in (True, False):
+        r = run(shared, args.txs, args.fixed_ms / 1e3, args.per_slot_us / 1e6)
+        label = "shared-cache+claims" if shared else "no-cache (honest baseline)"
+        print(f"{label:28s} {r}")
+
+
+if __name__ == "__main__":
+    main()
