@@ -28,3 +28,9 @@ assert len(jax.devices()) == 8, (
 )
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running soak scenarios (tier-1 runs -m 'not slow')"
+    )

@@ -54,6 +54,32 @@ def test_cache_lru_eviction_and_dedup():
     assert c.push(k[0])
 
 
+def test_unlocked_lru_cache_matches_locked_and_guards_free_threading():
+    """UnlockedLRUCache is semantically the locked cache minus the mutex;
+    its lock-freedom is only sound under the GIL, so on a free-threaded
+    build the constructor must hand back a locked LRUCache instead."""
+    import txflow_tpu.utils.cache as cache_mod
+    from txflow_tpu.utils.cache import UnlockedLRUCache
+
+    u, l = UnlockedLRUCache(3), LRUCache(3)
+    for key in [b"0", b"1", b"2", b"0", b"3", b"4"]:
+        assert u.push(key) == l.push(key)
+    assert len(u) == len(l) == 3
+    for key in (b"0", b"2", b"3", b"4"):
+        assert (key in u) == (key in l)
+
+    # simulate a free-threaded build: construction transparently degrades
+    # to the locked implementation (same API, GIL-independent safety)
+    orig = cache_mod._gil_enabled
+    cache_mod._gil_enabled = lambda: False
+    try:
+        fallback = UnlockedLRUCache(3)
+        assert isinstance(fallback, LRUCache)
+        assert fallback.push(b"x") and not fallback.push(b"x")
+    finally:
+        cache_mod._gil_enabled = orig
+
+
 # ---- TxVotePool ----
 
 
@@ -243,3 +269,87 @@ def test_ingest_log_compaction_bounds_memory():
         assert pool._log_base > 150
     finally:
         pool_base.COMPACT_THRESHOLD = old_threshold
+
+
+# ---- check_tx vs check_tx_many parity (the batched twins must not drift) ----
+
+
+def _drive_one_by_one(check, items):
+    out = []
+    for it in items:
+        try:
+            check(it)
+            out.append(None)
+        except Exception as e:
+            out.append(e)
+    return out
+
+
+def test_votepool_check_tx_many_parity():
+    """One ingest sequence — accepts, a duplicate, an oversized vote, a
+    pool-full rejection — pushed through check_tx one-by-one and through
+    check_tx_many as a batch: identical per-position error types and
+    identical final pool state (check_tx_many inlines a non-raising twin
+    of _ingest_locked; this is the drift alarm)."""
+    pv = MockPV()
+    v0, v1, v2, v3 = (make_vote(i, pv) for i in range(4))
+    big = make_vote(99, pv)
+    big.tx_hash = "A" * 1024  # encodes past max_msg_bytes
+    seq = [v0, v1, v0, big, v2, v3]
+
+    def mk():
+        return TxVotePool(MempoolConfig(size=3, cache_size=100, max_msg_bytes=256))
+
+    a, b = mk(), mk()
+    errs_one = _drive_one_by_one(a.check_tx, seq)
+    errs_many = b.check_tx_many(seq)
+
+    want = [None, ErrTxInCache, ErrTxTooLarge, ErrMempoolIsFull]
+    assert [type(e) for e in errs_one] == [type(e) for e in errs_many]
+    assert [type(e) for e in errs_many] == [
+        type(None), type(None), ErrTxInCache, ErrTxTooLarge,
+        type(None), ErrMempoolIsFull,
+    ], want
+    assert a.size() == b.size() == 3
+    assert a.txs_bytes() == b.txs_bytes()
+    assert [v.signature for _, v in a.entries()] == [
+        v.signature for _, v in b.entries()
+    ]
+    for v in (v0, v1, v2):
+        assert a.has(vote_key(v)) and b.has(vote_key(v))
+    # rejected votes left no residue in either pool
+    for v in (big, v3):
+        assert not a.has(vote_key(v)) and not b.has(vote_key(v))
+
+
+def test_mempool_check_tx_many_parity():
+    """Mempool twin of the votepool parity test: dup, byte-budget full,
+    pre_check rejection, and size-cap full must come out of check_tx and
+    check_tx_many with the same error types, order, and pool state."""
+    import hashlib as _h
+
+    def mk():
+        pool = Mempool(MempoolConfig(size=3, cache_size=100, max_txs_bytes=48))
+        pool.pre_check = lambda tx: "contains !" if b"!" in tx else None
+        return pool
+
+    seq = [b"a=1", b"b=2", b"a=1", b"x" * 64, b"bad!", b"c=3", b"d=4"]
+    a, b = mk(), mk()
+    errs_one = _drive_one_by_one(a.check_tx, seq)
+    errs_many = b.check_tx_many(seq)
+
+    assert [type(e) for e in errs_one] == [type(e) for e in errs_many]
+    assert [type(e) for e in errs_many] == [
+        type(None), type(None), ErrTxInCache, ErrMempoolIsFull,
+        ValueError, type(None), ErrMempoolIsFull,
+    ]
+    assert a.size() == b.size() == 3
+    assert a.txs_bytes() == b.txs_bytes() == 9
+    assert [t for _, t in a.entries()] == [t for _, t in b.entries()] == [
+        b"a=1", b"b=2", b"c=3"
+    ]
+    assert a.reap_max_txs(10) == b.reap_max_txs(10)
+    # a pre_check rejection must not poison the dedup cache: the same tx
+    # is retryable once the pool drains (cache.remove on reject)
+    for pool in (a, b):
+        assert _h.sha256(b"bad!").digest() not in pool.cache

@@ -521,6 +521,34 @@ def test_verify_cache_claim_ttl_reclaims_abandoned():
     assert v == [None] and not p[0]  # stale claim handed over
 
 
+def test_verify_cache_claim_keepalive_outlives_ttl():
+    """A device call slower than claim_ttl (a cold-shape compile runs
+    minutes) must NOT leak its claims mid-flight: the keepalive heartbeat
+    re-stamps them, so concurrent engines keep deferring instead of
+    re-verifying the same votes; once the owner exits, claims age out
+    normally."""
+    import time as _time
+
+    from txflow_tpu.verifier import VerifyCache
+
+    cache = VerifyCache(claim_ttl=0.05)
+    keys = [VerifyCache.key(b"m%d" % i, b"s" * 64, b"p" * 32) for i in range(3)]
+    _, pending = cache.lookup_or_claim_many(keys)
+    assert not any(pending)  # we own all three
+    with cache.claim_keepalive(keys):
+        _time.sleep(0.2)  # several TTLs inside the "device call"
+        _, p = cache.lookup_or_claim_many(keys)
+        assert all(p), "heartbeat must keep in-flight claims owned"
+    # owner exited without storing (the call failed): claims expire and
+    # the next asker takes over after the TTL
+    _time.sleep(0.08)
+    v, p = cache.lookup_or_claim_many(keys)
+    assert v == [None] * 3 and not any(p)
+    # keepalive over an empty claim list is a no-op context
+    with cache.claim_keepalive([]):
+        pass
+
+
 def test_shared_cache_pending_defers_instead_of_failing():
     """An engine that meets another engine's in-flight verifies must
     report those votes as dropped (deferred for retry) — never as

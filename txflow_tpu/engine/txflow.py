@@ -75,7 +75,13 @@ class TxFlow:
             self.verifier = verifier
         elif self.config.use_device:
             try:
-                self.verifier = DeviceVoteVerifier(val_set)
+                from ..verifier import ResilientVoteVerifier
+
+                # resilient by default: a device fault mid-run degrades to
+                # the scalar golden model (retry/backoff/re-probe policy,
+                # verifier.ResilientVoteVerifier) instead of erroring the
+                # vote path; decisions are bit-identical either way
+                self.verifier = ResilientVoteVerifier(DeviceVoteVerifier(val_set))
             except ValueError:  # total power >= 2^30: int32 tally overflow
                 self.verifier = ScalarVoteVerifier(val_set)
         else:
@@ -710,7 +716,7 @@ class TxFlow:
                 # a constructor failure cannot leave val_set/_addr_to_idx
                 # pointing at the new epoch while the verifier still gathers
                 # the old epoch's tables (wrong results, not an error).
-                from ..verifier import VerifierMux
+                from ..verifier import ResilientVoteVerifier, VerifierMux
 
                 base = self.verifier
                 if isinstance(base, VerifierMux):
@@ -718,6 +724,9 @@ class TxFlow:
                     # (other callers still run the old set): detach to a
                     # private verifier built like the mux's inner one
                     base = base.inner
+                resilient = isinstance(base, ResilientVoteVerifier)
+                if resilient:
+                    base = base.device
                 if isinstance(base, DeviceVoteVerifier):
                     try:
                         verifier = DeviceVoteVerifier(
@@ -725,6 +734,9 @@ class TxFlow:
                             mesh=base.mesh,
                             buckets=base.buckets,
                         )
+                        if resilient:
+                            # keep the degradation wrapper across rotations
+                            verifier = ResilientVoteVerifier(verifier)
                     except ValueError:
                         # total power >= 2^30: int32 device tally would
                         # overflow — documented fallback to the host path

@@ -1,0 +1,39 @@
+"""Fault injection: chaos p2p, Byzantine vote generators, crash drills.
+
+The paper's claim — per-tx quorum certification stays live and safe under
+a purely asynchronous vote flood — is only meaningful if it survives the
+conditions that define "asynchronous": lost/reordered/duplicated gossip,
+partitions, equivocating validators, nodes crashing mid-commit, and the
+verify accelerator itself failing. This package makes each of those a
+first-class, seed-reproducible test input:
+
+- ``FaultPlan`` / ``FaultSpec``  — deterministic per-link drop/delay/
+  duplicate decisions (plan.py);
+- ``ChaosRouter``        — installs a plan on live switches via the
+  ``Peer`` interceptor hook, schedules delayed deliveries, and cuts/heals
+  partitions (chaos.py);
+- ``byzantine``          — equivocating / garbage-signature / stale /
+  wrong-chain TxVote generators and block-vote equivocation evidence
+  (byzantine.py);
+- ``CrashDrill``         — build a durable node, kill it mid-run (optionally
+  at a failpoint), restart from WAL + stores, and compare replayed state
+  (crash.py);
+- ``FlakyVerifier``      — scripted device-verifier failures for exercising
+  ``ResilientVoteVerifier`` degradation (flaky.py).
+"""
+
+from .plan import FaultPlan, FaultSpec
+from .chaos import ChaosRouter
+from .crash import CrashDrill
+from .flaky import FlakyVerifier, InjectedDeviceError
+from . import byzantine
+
+__all__ = [
+    "FaultPlan",
+    "FaultSpec",
+    "ChaosRouter",
+    "CrashDrill",
+    "FlakyVerifier",
+    "InjectedDeviceError",
+    "byzantine",
+]

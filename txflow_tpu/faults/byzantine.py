@@ -1,0 +1,152 @@
+"""Byzantine validator behaviors, generated against types/tx_vote.py.
+
+Fast-path misbehavior is constructed here exactly as a hostile validator
+would emit it; what the honest net must do with each class:
+
+- equivocating re-signs — two valid signatures from one validator for one
+  tx (distinct signing timestamps => distinct sign bytes). NOT evidence by
+  design (types/evidence.py docstring: a yes-only vote has no conflicting
+  choice); the pool admits both as distinct entries and the engine's
+  authoritative TxVoteSet counts the validator's stake once,
+  first-signature-wins.
+- garbage / wrong-chain / forged-address signatures — fail device+scalar
+  verification identically; never enter a certificate; counted in
+  metrics.invalid_votes.
+- stale votes — heights far behind the net; valid signatures, but the
+  per-peer lag throttle stops gossiping them and certificates bind the
+  tx, not the height.
+- withheld votes — a validator that simply never signs (run a LocalNet
+  node with ``sign=False``); safety is unaffected, liveness holds while
+  honest stake > 2/3.
+
+Block-path equivocation (the slashable kind) is generated as conflicting
+``BlockVote`` pairs and detected through the types/evidence.py path
+(``DuplicateBlockVoteEvidence`` -> ``EvidencePool.add``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+
+from ..types.block_vote import PREVOTE, BlockVote
+from ..types.evidence import DuplicateBlockVoteEvidence
+from ..types.tx_vote import MAX_SIGNATURE_SIZE, TxVote
+
+
+def _tx_key(tx: bytes) -> bytes:
+    return hashlib.sha256(tx).digest()
+
+
+class ByzantineVoteGen:
+    """Deterministic generator of hostile TxVotes for one validator key.
+
+    ``priv_val`` is the byzantine validator's signer (a MockPV in tests);
+    ``seed`` fixes the garbage-signature bytes so a chaos run replays
+    identically.
+    """
+
+    def __init__(self, priv_val, chain_id: str, seed: int = 0):
+        self.pv = priv_val
+        self.chain_id = chain_id
+        self._rng = random.Random(seed)
+
+    def _vote(self, tx: bytes, height: int, timestamp_ns: int | None = None) -> TxVote:
+        key = _tx_key(tx)
+        v = TxVote(
+            height=height,
+            tx_hash=key.hex().upper(),
+            tx_key=key,
+            validator_address=self.pv.get_address(),
+        )
+        if timestamp_ns is not None:
+            v.timestamp_ns = timestamp_ns
+        return v
+
+    def honest_vote(self, tx: bytes, height: int = 0) -> TxVote:
+        v = self._vote(tx, height)
+        self.pv.sign_tx_vote(self.chain_id, v)
+        return v
+
+    def equivocating_pair(self, tx: bytes, height: int = 0) -> tuple[TxVote, TxVote]:
+        """Two VALID signatures for one (tx, validator): signing timestamps
+        differ, so sign bytes and signatures differ. The pool keys entries
+        by sha256(signature) and admits both; only one may contribute
+        stake to the certificate (first-signature-wins)."""
+        a = self._vote(tx, height, timestamp_ns=1_700_000_000_000_000_000)
+        b = self._vote(tx, height, timestamp_ns=1_700_000_000_000_000_001)
+        self.pv.sign_tx_vote(self.chain_id, a)
+        self.pv.sign_tx_vote(self.chain_id, b)
+        return a, b
+
+    def garbage_signature_vote(self, tx: bytes, height: int = 0) -> TxVote:
+        """Well-formed vote carrying seeded random bytes as a signature."""
+        v = self._vote(tx, height)
+        v.signature = bytes(
+            self._rng.getrandbits(8) for _ in range(MAX_SIGNATURE_SIZE)
+        )
+        return v
+
+    def wrong_chain_vote(self, tx: bytes, height: int = 0) -> TxVote:
+        """Validly signed — for a different chain id (replayed cross-chain
+        vote); verification against OUR chain id must fail."""
+        v = self._vote(tx, height)
+        self.pv.sign_tx_vote("byzantine-other-chain", v)
+        return v
+
+    def forged_address_vote(
+        self, tx: bytes, victim_address: bytes, height: int = 0
+    ) -> TxVote:
+        """Claims a victim validator's address over our own signature:
+        fails the pubkey/address binding check in TxVote.verify."""
+        key = _tx_key(tx)
+        v = TxVote(
+            height=height,
+            tx_hash=key.hex().upper(),
+            tx_key=key,
+            validator_address=victim_address,
+        )
+        v.signature = self.pv.sign_bytes_raw(v.sign_bytes(self.chain_id))
+        return v
+
+    def stale_vote(self, tx: bytes, height: int = 0, lag: int = 1000) -> TxVote:
+        """Validly signed at a height far behind the net (withheld, then
+        released long after)."""
+        v = self._vote(tx, max(0, height - lag))
+        self.pv.sign_tx_vote(self.chain_id, v)
+        return v
+
+
+def equivocating_block_votes(
+    priv_val,
+    chain_id: str,
+    height: int,
+    round_: int = 0,
+    vote_type: int = PREVOTE,
+) -> DuplicateBlockVoteEvidence:
+    """Slashable block-path equivocation: one validator, one
+    height/round/type, two different block ids — both validly signed.
+    ``EvidencePool.add`` must verify and admit the pair."""
+    votes = []
+    for block_id in (b"\xaa" * 32, b"\xbb" * 32):
+        v = BlockVote(
+            height=height,
+            round=round_,
+            type=vote_type,
+            block_id=block_id,
+            timestamp_ns=1_700_000_000_000_000_000,
+            validator_address=priv_val.get_address(),
+        )
+        priv_val.sign_block_vote(chain_id, v)
+        votes.append(v)
+    return DuplicateBlockVoteEvidence(votes[0], votes[1])
+
+
+def forged_block_vote_evidence(
+    priv_val, chain_id: str, height: int
+) -> DuplicateBlockVoteEvidence:
+    """An evidence pair whose second signature is garbage: the evidence
+    path must REJECT it (a forged accusation), not admit it."""
+    ev = equivocating_block_votes(priv_val, chain_id, height)
+    ev.vote_b.signature = b"\x01" * 64
+    return ev

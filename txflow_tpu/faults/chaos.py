@@ -1,0 +1,157 @@
+"""ChaosRouter: applies a FaultPlan to live switches.
+
+Installs itself as each switch's fault injector (``Switch.
+set_fault_injector``); every ``Peer.send``/``try_send`` then consults the
+router before enqueueing. Delivered-late and duplicated messages go
+through one scheduler thread and re-enter the peer's queue via
+``try_send_direct`` (bypassing the interceptor so a delayed message is
+not re-faulted — one decision per offered message).
+
+Partitions are orthogonal to the probabilistic plan: ``partition()``
+black-holes in-scope traffic crossing group boundaries without consuming
+the per-link PRNG streams, so ``heal()`` resumes the seeded sequence
+exactly where it left off.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from collections import Counter
+
+from .plan import DELAY, DELIVER, DROP, DUPLICATE, FaultPlan, FaultSpec
+
+
+class ChaosRouter:
+    def __init__(self, plan: FaultPlan | FaultSpec):
+        if isinstance(plan, FaultSpec):
+            plan = FaultPlan(plan)
+        self.plan = plan
+        self.stats: Counter = Counter()
+        self._heap: list = []  # (due, seq, peer, chan_id, msg)
+        self._seq = itertools.count()
+        self._cv = threading.Condition()
+        self._running = False
+        self._thread: threading.Thread | None = None
+        # groups of node ids; nodes in no group form one implicit group
+        self._partition: tuple[frozenset, ...] | None = None
+        self._switches: list = []
+
+    # -- wiring --
+
+    def install(self, switches) -> None:
+        """Register as fault injector on every switch (existing AND
+        future peers) and start the delayed-delivery scheduler."""
+        self.start()
+        for sw in switches:
+            sw.set_fault_injector(self)
+            self._switches.append(sw)
+
+    def uninstall(self) -> None:
+        for sw in self._switches:
+            sw.set_fault_injector(None)
+        self._switches = []
+        self.stop()
+
+    def make_interceptor(self, src: str, dst: str):
+        """Per-link hook handed to each Peer by Switch.set_fault_injector."""
+
+        def intercept(peer, chan_id: int, msg: bytes):
+            return self._route(peer, src, dst, chan_id, msg)
+
+        return intercept
+
+    # -- partitions --
+
+    def partition(self, *groups) -> None:
+        """Cut in-scope traffic between the given node-id groups (and
+        between any listed group and unlisted nodes)."""
+        self._partition = tuple(frozenset(g) for g in groups)
+
+    def heal(self) -> None:
+        self._partition = None
+
+    def _crosses_partition(self, src: str, dst: str) -> bool:
+        groups = self._partition
+        if groups is None:
+            return False
+
+        def group_of(node: str) -> int:
+            for i, g in enumerate(groups):
+                if node in g:
+                    return i
+            return -1  # unlisted nodes share one implicit group
+
+        return group_of(src) != group_of(dst)
+
+    # -- the per-message decision --
+
+    def _route(self, peer, src: str, dst: str, chan_id: int, msg: bytes):
+        # partition first, without consuming link randomness: heal()
+        # resumes the seeded fault sequence where it paused
+        if self._partition is not None and self.plan.in_scope(chan_id):
+            if self._crosses_partition(src, dst):
+                self.stats["partitioned"] += 1
+                return True  # swallowed: sender sees success (black hole)
+        kind, delay = self.plan.decide(src, dst, chan_id)
+        if kind == DELIVER:
+            return None  # pass through untouched
+        self.stats[kind] += 1
+        if kind == DROP:
+            return True
+        self._schedule(delay, peer, chan_id, msg)
+        # DELAY defers the original; DUPLICATE also delivers it now
+        return True if kind == DELAY else None
+
+    # -- delayed delivery --
+
+    def _schedule(self, delay: float, peer, chan_id: int, msg: bytes) -> None:
+        with self._cv:
+            if not self._running:
+                return  # router stopped mid-run: late copy is just dropped
+            heapq.heappush(
+                self._heap,
+                (time.monotonic() + delay, next(self._seq), peer, chan_id, msg),
+            )
+            self._cv.notify()
+
+    def start(self) -> None:
+        with self._cv:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(
+            target=self._run, name="chaos-scheduler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._running = False
+            self._heap.clear()
+            self._cv.notify()
+        t = self._thread
+        self._thread = None
+        if t is not None:
+            t.join(timeout=2)
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                if not self._running:
+                    return
+                if not self._heap:
+                    self._cv.wait(timeout=0.2)
+                    continue
+                due = self._heap[0][0] - time.monotonic()
+                if due > 0:
+                    self._cv.wait(timeout=due)
+                    continue
+                _, _, peer, chan_id, msg = heapq.heappop(self._heap)
+            # deliver outside the lock; bypass the interceptor so the
+            # late copy is not faulted again
+            if peer.is_running():
+                peer.try_send_direct(chan_id, msg)
+                self.stats["late_delivered"] += 1

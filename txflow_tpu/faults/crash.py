@@ -1,0 +1,140 @@
+"""CrashDrill: kill a durable node mid-run, restart it, compare state.
+
+Generalizes the crash-consistency test rig (tests/test_crash_recovery.py)
+into a reusable drill: one validator over durable artifacts (FileDB
+stores + pool WALs + consensus WAL) that can be crashed — optionally at
+an armed failpoint inside a commit path — and rebuilt from disk with a
+FRESH app. The restart model matches the reference's handshake replay:
+stores survive, the app restarts empty and is reconstructed by block
+replay + fast-path commit redelivery in persisted commit order, so
+"replay convergence" is checkable as exactly-once delivery plus a
+committed-order prefix match.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+
+from ..abci.kvstore import KVStoreApplication
+from ..node.node import Node, NodeConfig
+from ..store.db import FileDB
+from ..types.priv_validator import MockPV
+from ..types.tx_vote import TxVote
+from ..types.validator import Validator, ValidatorSet
+from ..utils import failpoints
+from ..utils.config import test_config
+
+
+class CrashDrill:
+    def __init__(
+        self,
+        root_dir,
+        chain_id: str = "txflow-crash-drill",
+        enable_consensus: bool = False,
+        app_factory=KVStoreApplication,
+        use_device_verifier: bool = False,
+        seed: bytes = b"crash-drill-val",
+    ):
+        self.root = str(root_dir)
+        self.chain_id = chain_id
+        self.enable_consensus = enable_consensus
+        self.app_factory = app_factory
+        self.use_device_verifier = use_device_verifier
+        self.pv = MockPV(hashlib.sha256(seed).digest())
+        self.val_set = ValidatorSet(
+            [Validator.from_pub_key(self.pv.get_pub_key(), 10)]
+        )
+        self.node: Node | None = None
+        self.app = None
+        self.restarts = 0
+
+    # -- lifecycle --
+
+    def _build(self, app=None) -> Node:
+        cfg = test_config()
+        cfg.consensus.skip_timeout_commit = True
+        cfg.mempool.wal_dir = self.root
+        self.app = app if app is not None else self.app_factory()
+        return Node(
+            node_id="crash-drill",
+            chain_id=self.chain_id,
+            val_set=self.val_set,
+            app=self.app,
+            priv_val=self.pv,
+            node_config=NodeConfig(
+                config=cfg,
+                use_device_verifier=self.use_device_verifier,
+                enable_consensus=self.enable_consensus,
+                consensus_wal_path=f"{self.root}/consensus.wal",
+            ),
+            tx_store_db=FileDB(f"{self.root}/txstore.db"),
+            state_db=FileDB(f"{self.root}/state.db"),
+            block_db=FileDB(f"{self.root}/blocks.db"),
+        )
+
+    def start(self, app=None) -> Node:
+        assert self.node is None, "drill node already running"
+        self.node = self._build(app)
+        self.node.start()
+        return self.node
+
+    def crash(self, failpoint: str | None = None, timeout: float = 20.0) -> None:
+        """Stop the node. With ``failpoint``, arm it first and wait for a
+        commit path to hit it, so the on-disk state is the partial state
+        the failpoint models (utils.failpoints)."""
+        assert self.node is not None, "drill node not running"
+        if failpoint is not None:
+            if not failpoints.fired(failpoint):
+                failpoints.arm(failpoint)
+                deadline = time.monotonic() + timeout
+                while not failpoints.fired(failpoint):
+                    if time.monotonic() > deadline:
+                        failpoints.disarm()
+                        raise TimeoutError(f"failpoint {failpoint} never fired")
+                    time.sleep(0.01)
+        self.node.stop()
+        failpoints.disarm()
+        self.node = None
+
+    def restart(self, app=None) -> Node:
+        """Rebuild over the same durable artifacts with a fresh app and
+        start (handshake replay runs inside Node.start)."""
+        self.restarts += 1
+        return self.start(app)
+
+    def stop(self) -> None:
+        if self.node is not None:
+            self.node.stop()
+            self.node = None
+        failpoints.disarm()
+
+    # -- traffic + assertions --
+
+    def submit(self, tx: bytes) -> None:
+        """Client ingress + the validator's own vote (signed inline so the
+        drill does not race the signTxRoutine's walk)."""
+        assert self.node is not None
+        self.node.broadcast_tx(tx)
+        key = hashlib.sha256(tx).digest()
+        v = TxVote(
+            height=0,
+            tx_hash=key.hex().upper(),
+            tx_key=key,
+            validator_address=self.pv.get_address(),
+        )
+        self.pv.sign_tx_vote(self.chain_id, v)
+        self.node.tx_vote_pool.check_tx(v)
+
+    def wait_committed(self, txs, timeout: float = 20.0, poll: float = 0.01) -> bool:
+        assert self.node is not None
+        deadline = time.monotonic() + timeout
+        while not all(self.node.is_committed(t) for t in txs):
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(poll)
+        return True
+
+    def committed_order(self) -> list[str]:
+        assert self.node is not None
+        return self.node.tx_store.committed_hashes_in_order()

@@ -1,0 +1,50 @@
+"""FlakyVerifier: scripted device-verifier failures.
+
+A transparent proxy around any verifier that raises
+``InjectedDeviceError`` on a deterministic schedule — the first N calls,
+an explicit call-index set, or whenever ``failing`` is toggled on (for
+"device dies mid-run, later recovers" scenarios). Wrapped in
+``verifier.ResilientVoteVerifier`` it exercises the full degradation
+policy: retry/backoff, CPU fallback, and device re-promotion.
+"""
+
+from __future__ import annotations
+
+
+class InjectedDeviceError(RuntimeError):
+    """A deliberately injected device-verifier failure."""
+
+
+class FlakyVerifier:
+    def __init__(
+        self,
+        inner,
+        fail_first: int = 0,
+        fail_calls=(),
+        error_factory=None,
+    ):
+        self.inner = inner
+        self.val_set = inner.val_set
+        self.cache = getattr(inner, "cache", None)
+        mb = getattr(inner, "max_batch", None)
+        if mb is not None:
+            self.max_batch = mb
+        self.fail_first = fail_first
+        self.fail_calls = set(fail_calls)
+        self.failing = False  # toggle: fail every call while True
+        self.calls = 0
+        self.failures = 0
+        self._make_error = error_factory or (
+            lambda i: InjectedDeviceError(f"injected device failure (call {i})")
+        )
+
+    def warmup(self, n: int = 1, full: bool = False) -> None:
+        self.inner.warmup(n, full=full)
+
+    def verify_and_tally(self, *args, **kwargs):
+        i = self.calls
+        self.calls += 1
+        if self.failing or i < self.fail_first or i in self.fail_calls:
+            self.failures += 1
+            raise self._make_error(i)
+        return self.inner.verify_and_tally(*args, **kwargs)

@@ -1,0 +1,126 @@
+"""FaultPlan: deterministic, seed-driven link-fault decisions.
+
+Each directed link (src node -> dst node) gets its own PRNG stream seeded
+from sha256(seed, src, dst), and decisions are drawn in per-link message
+order. The decision SEQUENCE per link is therefore a pure function of
+(seed, src, dst, message index) — rerunning a net with the same seed
+replays the same fault pattern per link, regardless of how the OS
+interleaves threads across links. (Wall-clock interleaving between links
+is inherently nondeterministic; the per-link trace is what "same seed =>
+same fault trace" means, and what test_chaos asserts.)
+
+Decisions never consume randomness for out-of-scope channels, so adding
+consensus traffic to a net does not shift the gossip-channel stream.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+from dataclasses import dataclass
+
+from ..p2p.base import CHANNEL_MEMPOOL, CHANNEL_TXVOTE
+
+# default chaos scope: the at-least-once gossip channels. Consensus
+# channels (0x20-0x22) are push-once state-machine traffic; faulting them
+# exercises the BFT view-change path, not the fast path, and needs its
+# own liveness budget — opt in via FaultSpec.channels.
+GOSSIP_CHANNELS = frozenset((CHANNEL_MEMPOOL, CHANNEL_TXVOTE))
+
+# decision kinds (first element of a trace entry / decide() result)
+DELIVER = "deliver"
+DROP = "drop"
+DELAY = "delay"
+DUPLICATE = "duplicate"
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """Per-message fault probabilities and delay bounds.
+
+    Probabilities are evaluated in order drop -> duplicate -> delay on one
+    uniform draw, so they must sum to <= 1; the remainder delivers clean.
+    A duplicate delivers the original immediately AND schedules a delayed
+    copy; a delay defers the original — both produce reordering relative
+    to messages sent after them on the same link.
+    """
+
+    seed: int = 0
+    drop: float = 0.0
+    duplicate: float = 0.0
+    delay: float = 0.0
+    delay_min: float = 0.005
+    delay_max: float = 0.05
+    channels: frozenset = GOSSIP_CHANNELS  # None = every channel
+
+    def __post_init__(self):
+        total = self.drop + self.duplicate + self.delay
+        if not 0 <= total <= 1:
+            raise ValueError(f"fault probabilities sum to {total}, need [0, 1]")
+        if self.delay_min < 0 or self.delay_max < self.delay_min:
+            raise ValueError("need 0 <= delay_min <= delay_max")
+
+
+class FaultPlan:
+    """Seeded decision source consulted once per intercepted message.
+
+    ``decide(src, dst, chan_id)`` returns ``(kind, delay_seconds)`` where
+    kind is one of DELIVER/DROP/DELAY/DUPLICATE and delay_seconds is 0.0
+    unless the message (or its duplicate copy) is deferred. Every non-
+    DELIVER decision is appended to ``trace`` as
+    ``(src, dst, msg_index, kind, delay)``.
+    """
+
+    def __init__(self, spec: FaultSpec):
+        self.spec = spec
+        self._mtx = threading.Lock()
+        self._links: dict[tuple[str, str], random.Random] = {}
+        self._counts: dict[tuple[str, str], int] = {}
+        self.trace: list[tuple[str, str, int, str, float]] = []
+
+    def _link_rng(self, src: str, dst: str) -> random.Random:
+        key = (src, dst)
+        rng = self._links.get(key)
+        if rng is None:
+            digest = hashlib.sha256(
+                b"faultplan|%d|%s|%s" % (self.spec.seed, src.encode(), dst.encode())
+            ).digest()
+            rng = random.Random(int.from_bytes(digest[:8], "little"))
+            self._links[key] = rng
+            self._counts[key] = 0
+        return rng
+
+    def in_scope(self, chan_id: int) -> bool:
+        return self.spec.channels is None or chan_id in self.spec.channels
+
+    def decide(self, src: str, dst: str, chan_id: int) -> tuple[str, float]:
+        if not self.in_scope(chan_id):
+            return DELIVER, 0.0
+        s = self.spec
+        with self._mtx:
+            rng = self._link_rng(src, dst)
+            n = self._counts[(src, dst)]
+            self._counts[(src, dst)] = n + 1
+            r = rng.random()
+            if r < s.drop:
+                kind, delay = DROP, 0.0
+            elif r < s.drop + s.duplicate:
+                kind = DUPLICATE
+                delay = rng.uniform(s.delay_min, s.delay_max)
+            elif r < s.drop + s.duplicate + s.delay:
+                kind = DELAY
+                delay = rng.uniform(s.delay_min, s.delay_max)
+            else:
+                return DELIVER, 0.0
+            self.trace.append((src, dst, n, kind, delay))
+            return kind, delay
+
+    def link_trace(self, src: str, dst: str) -> list[tuple[int, str, float]]:
+        """The (msg_index, kind, delay) sequence recorded for one link."""
+        with self._mtx:
+            return [
+                (n, kind, delay)
+                for (s, d, n, kind, delay) in self.trace
+                if s == src and d == dst
+            ]

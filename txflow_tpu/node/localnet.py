@@ -45,6 +45,8 @@ class LocalNet:
         rpc: bool = False,  # True: each node serves HTTP RPC on an ephemeral port
         index_txs: bool = True,
         n_nodes: int | None = None,
+        fault_plan=None,  # FaultSpec/FaultPlan/ChaosRouter: chaos p2p (faults/)
+        regossip_interval: float | None = None,
     ):
         """n_nodes: host only the first n_nodes validators as full nodes
         (default: one node per validator). A large validator set does not
@@ -74,6 +76,29 @@ class LocalNet:
             raise ValueError(
                 f"n_nodes must be in [1, {len(priv_vals)}], got {n_nodes}"
             )
+        if enable_consensus and n_nodes is not None and n_nodes < len(priv_vals):
+            # mirror the bench.py guard: a hosted subset cannot reach block
+            # quorum — the missing validators never prevote, so consensus
+            # silently hangs at round 0 instead of failing fast
+            raise ValueError(
+                f"enable_consensus requires hosting all {len(priv_vals)} "
+                f"validators (n_nodes={n_nodes}): a hosted subset cannot "
+                "reach block quorum"
+            )
+        # chaos rig (faults/): accept a FaultSpec, a FaultPlan, or a
+        # pre-built ChaosRouter; installed on every switch in start().
+        # Lossy links need the reactors' anti-entropy re-walk for
+        # liveness — default it on (250 ms) whenever chaos is active.
+        self.chaos: "ChaosRouter | None" = None
+        if fault_plan is not None:
+            from ..faults import ChaosRouter
+            from ..faults.chaos import FaultPlan, FaultSpec
+
+            if isinstance(fault_plan, (FaultSpec, FaultPlan)):
+                fault_plan = ChaosRouter(fault_plan)
+            self.chaos = fault_plan
+            if regossip_interval is None:
+                regossip_interval = 0.25
         hosted = priv_vals if n_nodes is None else priv_vals[:n_nodes]
         for i, pv in enumerate(hosted):
             node = Node(
@@ -101,11 +126,16 @@ class LocalNet:
                     consensus_wal_path=(
                         f"{wal_dir}/node{i}-consensus.wal" if wal_dir else ""
                     ),
+                    regossip_interval=regossip_interval,
                 ),
             )
             self.nodes.append(node)
 
     def start(self) -> None:
+        if self.chaos is not None:
+            # before connect: interceptors must cover the peers the full
+            # mesh is about to create
+            self.chaos.install([n.switch for n in self.nodes])
         for node in self.nodes:
             node.start()
         # full mesh (reference MakeConnectedSwitches connects all pairs)
@@ -116,6 +146,8 @@ class LocalNet:
     def stop(self) -> None:
         for node in self.nodes:
             node.stop()
+        if self.chaos is not None:
+            self.chaos.uninstall()
 
     # -- client helpers --
 

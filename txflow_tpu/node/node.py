@@ -70,6 +70,13 @@ class NodeConfig:
     # ed25519 node key seed: enables authenticated secret connections on
     # TCP links (reference p2p.LoadOrGenNodeKey, node/node.go:72)
     node_key_seed: bytes | None = None
+    # anti-entropy re-gossip cadence for lossy links (chaos rigs, real
+    # networks); None = single-pass cursor walks (reactor docstrings)
+    regossip_interval: float | None = None
+    # wrap a node-built DeviceVoteVerifier in ResilientVoteVerifier
+    # (bounded retry -> CPU fallback -> device re-promotion) so a device
+    # failure degrades throughput instead of erroring the vote path
+    resilient_verifier: bool = True
 
 
 class Node:
@@ -170,9 +177,11 @@ class Node:
             self.config.engine, use_device=nc.use_device_verifier
         )
         if verifier is None and nc.use_device_verifier and mesh is not None:
-            from ..verifier import DeviceVoteVerifier
+            from ..verifier import DeviceVoteVerifier, ResilientVoteVerifier
 
             verifier = DeviceVoteVerifier(val_set, mesh=mesh)
+            if nc.resilient_verifier:
+                verifier = ResilientVoteVerifier(verifier)
         self.txflow = TxFlow(
             chain_id,
             self._last_block_height,
@@ -203,6 +212,7 @@ class Node:
             self.mempool,
             broadcast=mp_bcast,
             batch_size=nc.gossip_batch,
+            regossip_interval=nc.regossip_interval,
         )
         self.txvote_reactor = TxVoteReactor(
             self.state_view,
@@ -211,6 +221,7 @@ class Node:
             priv_val=priv_val if nc.sign_votes else None,
             broadcast=vote_bcast,
             batch_size=nc.gossip_batch,
+            regossip_interval=nc.regossip_interval,
         )
         self.switch.add_reactor("mempool", self.mempool_reactor)
         self.switch.add_reactor("txvote", self.txvote_reactor)

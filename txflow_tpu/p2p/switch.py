@@ -51,6 +51,12 @@ class Peer:
         self._running = threading.Event()
         self._send_thread: threading.Thread | None = None
         self._recv_thread: threading.Thread | None = None
+        # fault-injection hook (faults.chaos.ChaosRouter): consulted by
+        # send/try_send with (peer, chan_id, msg); returns None to pass the
+        # message through, or a bool send-result when it handled (dropped,
+        # deferred, duplicated) it. Installed via Switch.set_fault_injector;
+        # None (the default) costs one attribute read on the send path.
+        self.intercept = None
 
     def set(self, key: str, value) -> None:
         self.kv[key] = value
@@ -78,6 +84,17 @@ class Peer:
         """Queue a message; blocks under backpressure. False if peer down."""
         if not self._running.is_set():
             return False
+        ic = self.intercept
+        if ic is not None:
+            handled = ic(self, chan_id, msg)
+            if handled is not None:
+                return handled
+        return self.send_direct(chan_id, msg, timeout)
+
+    def send_direct(self, chan_id: int, msg: bytes, timeout: float | None = 10.0) -> bool:
+        """send() minus the fault-injection hook (chaos late deliveries)."""
+        if not self._running.is_set():
+            return False
         if self._is_reliable(chan_id):
             return self._put_reliable(chan_id, msg)
         prio = -self._channels[chan_id].priority if chan_id in self._channels else 0
@@ -88,6 +105,16 @@ class Peer:
             return False
 
     def try_send(self, chan_id: int, msg: bytes) -> bool:
+        if not self._running.is_set():
+            return False
+        ic = self.intercept
+        if ic is not None:
+            handled = ic(self, chan_id, msg)
+            if handled is not None:
+                return handled
+        return self.try_send_direct(chan_id, msg)
+
+    def try_send_direct(self, chan_id: int, msg: bytes) -> bool:
         if not self._running.is_set():
             return False
         if self._is_reliable(chan_id):
@@ -136,6 +163,7 @@ class Switch:
         self._peers: dict[str, Peer] = {}
         self._mtx = threading.RLock()
         self._running = False
+        self._fault_injector = None
 
     # -- reactor registry (reference Switch.AddReactor) --
 
@@ -194,9 +222,29 @@ class Switch:
         with self._mtx:
             return self._peers.get(node_id)
 
+    def set_fault_injector(self, injector) -> None:
+        """Install (or clear, with None) a fault injector on this switch:
+        every current and future peer's send path consults
+        ``injector.make_interceptor(self.node_id, peer.node_id)``
+        (faults.chaos.ChaosRouter). Test/chaos-rig plumbing — never set in
+        production assembly."""
+        with self._mtx:
+            self._fault_injector = injector
+            peers = list(self._peers.values())
+        for p in peers:
+            p.intercept = (
+                None
+                if injector is None
+                else injector.make_interceptor(self.node_id, p.node_id)
+            )
+
     def add_peer_conn(self, conn, node_id: str, outbound: bool) -> Peer:
         """Attach a live connection as a peer and start its loops."""
         peer = Peer(conn, node_id, outbound, dict(self._channels))
+        if self._fault_injector is not None:
+            peer.intercept = self._fault_injector.make_interceptor(
+                self.node_id, node_id
+            )
         with self._mtx:
             if not self._running:
                 # a handshake completing during/after stop() must not

@@ -45,12 +45,16 @@ class MempoolReactor(Reactor):
         broadcast: bool = True,
         batch_size: int = 1024,
         poll_interval: float = 0.05,
+        regossip_interval: float | None = None,
     ):
         super().__init__("mempool")
         self.mempool = mempool
         self.broadcast = broadcast
         self.batch_size = batch_size
         self.poll_interval = poll_interval
+        # anti-entropy re-walk cadence for lossy links; None = single-pass
+        # walk (see TxVoteReactor.regossip_interval for the rationale)
+        self.regossip_interval = regossip_interval
         self._running = threading.Event()
         self._peer_ids: dict[str, int] = {}
         self._next_peer_id = 1
@@ -126,12 +130,21 @@ class MempoolReactor(Reactor):
         cursor = 0
         pending: list[tuple[bytes, bytes, int, bool]] = []
         seq = self.mempool.seq()
+        last_rewalk = time.monotonic()
         while self._running.is_set() and peer.is_running():
             if not pending:
                 pending, cursor = self.mempool.entries_from(
                     cursor, limit=self.batch_size
                 )
             if not pending:
+                if (
+                    self.regossip_interval is not None
+                    and time.monotonic() - last_rewalk >= self.regossip_interval
+                    and self.mempool.size() > 0
+                ):
+                    cursor = 0  # anti-entropy re-walk (see __init__)
+                    last_rewalk = time.monotonic()
+                    continue
                 seq = self.mempool.wait_for_new(seq, timeout=self.poll_interval)
                 continue
             peer_height = peer.get(PEER_HEIGHT_KEY, 0)

@@ -74,6 +74,7 @@ class TxVoteReactor(Reactor):
         broadcast: bool = True,
         batch_size: int = 1024,
         poll_interval: float = 0.05,
+        regossip_interval: float | None = None,
     ):
         super().__init__("txvote")
         self.get_state = get_state
@@ -83,6 +84,14 @@ class TxVoteReactor(Reactor):
         self.broadcast = broadcast
         self.batch_size = batch_size
         self.poll_interval = poll_interval
+        # anti-entropy for lossy links (faults.chaos): the cursor walk
+        # ships each pool entry to each peer exactly once, so a frame lost
+        # in transit is never offered to that peer again. When set, an
+        # idle broadcast routine re-walks the live pool every interval;
+        # receivers dedup re-offers cheaply (wire cache + pool signature
+        # dedup). None (default) keeps the single-pass walk — in-memory
+        # pipes don't lose frames, and the re-walk is pure overhead there.
+        self.regossip_interval = regossip_interval
         self._running = threading.Event()
         self._peer_ids: dict[str, int] = {}  # node_id -> small int (txVotePoolIDs)
         self._next_peer_id = 1
@@ -283,12 +292,21 @@ class TxVoteReactor(Reactor):
         cursor = 0
         pending: list[tuple[bytes, TxVote, int, bytes]] = []
         seq = self.tx_vote_pool.seq()
+        last_rewalk = time.monotonic()
         while self._running.is_set() and peer.is_running():
             if not pending:
                 pending, cursor = self.tx_vote_pool.entries_from(
                     cursor, limit=self.batch_size
                 )
             if not pending:
+                if (
+                    self.regossip_interval is not None
+                    and time.monotonic() - last_rewalk >= self.regossip_interval
+                    and self.tx_vote_pool.size() > 0
+                ):
+                    cursor = 0  # anti-entropy re-walk (see __init__)
+                    last_rewalk = time.monotonic()
+                    continue
                 seq = self.tx_vote_pool.wait_for_new(seq, timeout=self.poll_interval)
                 continue
             peer_height = peer.get(PEER_HEIGHT_KEY, 0)

@@ -11,8 +11,22 @@ host-path item at bench rates, r5 instrumented profile).
 
 from __future__ import annotations
 
+import sys
 import threading
 from collections import OrderedDict
+
+
+def _gil_enabled() -> bool:
+    """True unless this is a free-threaded (PEP 703) build running with
+    the GIL actually disabled. sys._is_gil_enabled only exists on
+    free-threaded builds (3.13+); its absence means a GIL build."""
+    probe = getattr(sys, "_is_gil_enabled", None)
+    if probe is None:
+        return True
+    try:
+        return bool(probe())
+    except Exception:
+        return True
 
 
 class LRUCache:
@@ -61,7 +75,23 @@ class UnlockedLRUCache:
     other threads stay safe: membership tests on a plain dict never
     observe torn state under the GIL, and the reactor's in_cache peek
     tolerates stale answers by falling back to the authoritative
-    check_tx path."""
+    check_tx path.
+
+    The safety argument is CPython-specific and GIL-specific: ``in``,
+    ``del``, and item assignment on a dict are single bytecode-dispatched
+    C operations, and the GIL guarantees a reader never observes a dict
+    mid-resize or mid-insert. It does NOT hold on free-threaded (PEP 703)
+    builds, where an unsynchronized reader racing push()'s delete +
+    re-insert pair is genuine undefined behavior. On such builds (checked
+    once at construction via sys._is_gil_enabled) the constructor
+    transparently returns a locked ``LRUCache`` instead — every call site
+    keeps its semantics and pays the lock only where the GIL no longer
+    provides it."""
+
+    def __new__(cls, size: int):
+        if not _gil_enabled():
+            return LRUCache(size)
+        return object.__new__(cls)
 
     def __init__(self, size: int):
         if size <= 0:

@@ -22,6 +22,7 @@ exactly like the reference.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -165,6 +166,56 @@ class VerifyCache:
             while len(d) > self.capacity:
                 d.popitem(last=False)
 
+    def heartbeat_many(self, keys: list[bytes]) -> None:
+        """Re-stamp still-live claims: the owner's verify call is in
+        flight but slow. Claims already released/stored are left alone."""
+        now = time.monotonic()
+        with self._mtx:
+            infl = self._inflight
+            for k in keys:
+                if k in infl:
+                    infl[k] = now
+
+    def claim_keepalive(self, keys: list[bytes]) -> "_ClaimKeepalive":
+        """Context manager that heartbeats the given claims every
+        claim_ttl/2 until exit. The TTL (3 s) is sized for a warm verify
+        step, but the owner's device call can exceed it by orders of
+        magnitude — a cold-shape compile runs minutes on TPU — and once a
+        claim goes stale every other engine re-claims the same votes and
+        launches its own compile of the same cold shape (N concurrent
+        compiles for one shape). The heartbeat keeps ownership exactly as
+        long as the owner is actually working."""
+        return _ClaimKeepalive(self, keys)
+
+
+class _ClaimKeepalive:
+    """Background heartbeat for VerifyCache claims (claim_keepalive)."""
+
+    def __init__(self, cache: VerifyCache, keys: list[bytes]):
+        self._cache = cache
+        self._keys = keys
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def __enter__(self) -> "_ClaimKeepalive":
+        if self._keys:
+            self._thread = threading.Thread(
+                target=self._run, name="verify-claim-keepalive", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        interval = max(self._cache.claim_ttl / 2, 0.01)
+        while not self._stop.wait(interval):
+            self._cache.heartbeat_many(self._keys)
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+            self._thread = None
+
 
 @dataclass
 class TallyResult:
@@ -258,18 +309,27 @@ class ScalarVoteVerifier:
             # DEFERRED (dropped mask), not re-verified — each unique vote
             # costs one host verify process-wide instead of one per engine
             cached, pending = self.cache.lookup_or_claim_many(keys)
+            claimed = [
+                keys[i]
+                for i in range(n)
+                if keys[i] is not None and not pending[i] and cached[i] is None
+            ]
             stores = []
             try:
-                for i in range(n):
-                    if keys[i] is None or pending[i]:
-                        continue
-                    if cached[i] is not None:
-                        valid[i] = cached[i]
-                    else:
-                        valid[i] = host_ed.verify(
-                            self._pub_keys[int(val_idx[i])], msgs[i], sigs[i]
-                        )
-                        stores.append((keys[i], bool(valid[i])))
+                # keepalive: a big miss sweep at ~50 us/verify can outlive
+                # the claim TTL; stale claims would hand the same votes to
+                # every other engine mid-sweep
+                with self.cache.claim_keepalive(claimed):
+                    for i in range(n):
+                        if keys[i] is None or pending[i]:
+                            continue
+                        if cached[i] is not None:
+                            valid[i] = cached[i]
+                        else:
+                            valid[i] = host_ed.verify(
+                                self._pub_keys[int(val_idx[i])], msgs[i], sigs[i]
+                            )
+                            stores.append((keys[i], bool(valid[i])))
             except BaseException:
                 # free every claimed-but-unverified key (waiters would
                 # otherwise stall until the TTL), then surface the error
@@ -541,16 +601,22 @@ class DeviceVoteVerifier:
             else:
                 valid[i] = cached[i]
         if miss_idx:
+            miss_keys = [keys[i] for i in miss_idx]
             try:
-                sub_valid = self._verify_only(
-                    [msgs[i] for i in miss_idx],
-                    [sigs[i] for i in miss_idx],
-                    val_idx[miss_idx],
-                )
+                # keepalive: the device call can exceed the claim TTL by
+                # orders of magnitude (cold-shape compiles run minutes on
+                # TPU); without it, expired claims trigger N concurrent
+                # compiles of the same shape (VerifyCache.claim_keepalive)
+                with self.cache.claim_keepalive(miss_keys):
+                    sub_valid = self._verify_only(
+                        [msgs[i] for i in miss_idx],
+                        [sigs[i] for i in miss_idx],
+                        val_idx[miss_idx],
+                    )
             except BaseException:
                 # claims must not outlive a failed verify (waiters would
                 # stall until the TTL) — hand them to the next asker
-                self.cache.release_many([keys[i] for i in miss_idx])
+                self.cache.release_many(miss_keys)
                 raise
             self.cache.store_many(
                 [(keys[i], bool(v)) for i, v in zip(miss_idx, sub_valid)]
@@ -604,6 +670,151 @@ class DeviceVoteVerifier:
         rows = packed.reshape(self._n_shards, -1)
         bs = b // self._n_shards
         return rows[:, :bs].reshape(-1).astype(bool)[:n]
+
+
+class ResilientVoteVerifier:
+    """Graceful degradation around a device verifier.
+
+    Policy, in order:
+
+    1. bounded retry — a device error is retried up to ``max_attempts``
+       times with exponential backoff (base*2^k, capped at backoff_max);
+    2. CPU fallback — on exhaustion the verifier DEMOTES: the batch (and
+       subsequent batches) are served by ``ScalarVoteVerifier``, the
+       golden model, so commits keep flowing at host speed instead of the
+       vote path erroring;
+    3. recovery probing — while demoted, one caller per ``probe_interval``
+       offers its live batch to the device again; success RE-PROMOTES,
+       failure re-arms the probe timer and falls back.
+
+    Decisions are unaffected by which path serves a batch: the scalar and
+    device verifiers return bit-identical masks and quorum decisions
+    (module docstring), so degradation is observable only as latency and
+    in the counters here. Used as a ``VerifierMux`` inner (or directly as
+    an engine verifier) this keeps a device failure from reaching
+    ``_fail_queued`` — the mux's inner call succeeds on the CPU path, so
+    queued requests are answered instead of errored.
+
+    The device's shared VerifyCache (when present) is handed to the
+    fallback too: verdicts cached by either path serve both, and claims
+    released by a failed device call are re-claimable by the fallback.
+
+    ``sleep``/``clock`` are injectable for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        device,
+        fallback=None,
+        max_attempts: int = 3,
+        backoff_base: float = 0.05,
+        backoff_max: float = 2.0,
+        probe_interval: float = 5.0,
+        sleep=time.sleep,
+        clock=time.monotonic,
+    ):
+        self.device = device
+        self.val_set = device.val_set
+        self.cache = getattr(device, "cache", None)
+        if fallback is None:
+            fallback = ScalarVoteVerifier(self.val_set, shared_cache=self.cache)
+        self.fallback = fallback
+        mb = getattr(device, "max_batch", None)
+        if mb is not None:
+            self.max_batch = mb
+        self.max_attempts = max(1, max_attempts)
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.probe_interval = probe_interval
+        self._sleep = sleep
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._device_ok = True
+        self._next_probe = 0.0
+        # observability (bench/RPC surface them; tests assert transitions)
+        self.device_failures = 0
+        self.fallback_calls = 0
+        self.demotions = 0
+        self.repromotions = 0
+        self.last_error: Exception | None = None
+        self.on_state_change = lambda healthy: None
+
+    @property
+    def device_healthy(self) -> bool:
+        return self._device_ok
+
+    def _should_try_device(self) -> bool:
+        with self._lock:
+            if self._device_ok:
+                return True
+            now = self._clock()
+            if now >= self._next_probe:
+                # re-arm BEFORE probing so concurrent callers don't all
+                # pay the probe latency; exactly one per interval does
+                self._next_probe = now + self.probe_interval
+                return True
+            return False
+
+    def _mark_device(self, healthy: bool) -> None:
+        with self._lock:
+            changed = self._device_ok != healthy
+            self._device_ok = healthy
+            if changed:
+                if healthy:
+                    self.repromotions += 1
+                else:
+                    self.demotions += 1
+                    self._next_probe = self._clock() + self.probe_interval
+        if changed:
+            try:
+                self.on_state_change(healthy)
+            except Exception:
+                pass
+
+    def warmup(self, n: int = 1, full: bool = False) -> None:
+        try:
+            self.device.warmup(n, full=full)
+        except Exception as e:
+            with self._lock:
+                self.device_failures += 1
+                self.last_error = e
+            self._mark_device(False)
+
+    def verify_and_tally(
+        self,
+        msgs,
+        sigs,
+        val_idx,
+        tx_slot,
+        n_slots,
+        prior_stake=None,
+        quorum=None,
+    ) -> TallyResult:
+        if self._should_try_device():
+            delay = self.backoff_base
+            for attempt in range(self.max_attempts):
+                try:
+                    result = self.device.verify_and_tally(
+                        msgs, sigs, val_idx, tx_slot, n_slots,
+                        prior_stake=prior_stake, quorum=quorum,
+                    )
+                except Exception as e:
+                    with self._lock:
+                        self.device_failures += 1
+                        self.last_error = e
+                    if attempt + 1 < self.max_attempts:
+                        self._sleep(min(delay, self.backoff_max))
+                        delay *= 2
+                else:
+                    self._mark_device(True)
+                    return result
+            self._mark_device(False)
+        with self._lock:
+            self.fallback_calls += 1
+        return self.fallback.verify_and_tally(
+            msgs, sigs, val_idx, tx_slot, n_slots,
+            prior_stake=prior_stake, quorum=quorum,
+        )
 
 
 def _pad(a: np.ndarray, pad: int) -> np.ndarray:
