@@ -37,10 +37,14 @@ def test_rpc_end_to_end_client_flow():
         addr2 = net.nodes[2].rpc.addr
 
         # health + status
-        assert rpc_get(addr0, "/health")["result"] == {}
+        health = rpc_get(addr0, "/health")["result"]
+        assert {"healthy", "watchdog", "peers", "verifier", "progress"} <= set(
+            health
+        )
         st = rpc_get(addr0, "/status")["result"]
         assert st["node_info"]["network"] == "txflow-localnet"
         assert st["node_info"]["protocol_version"]["block"] >= 1
+        assert st["health"]["monitored"] is True
 
         # client submits a tx to node0 over HTTP
         tx = b"rpc-k=v"
@@ -424,7 +428,7 @@ def test_rpc_hardening_body_cap_and_connection_cap():
         assert b"413" in resp.split(b"\r\n", 1)[0], resp[:100]
         s.close()
         # server still serves normal requests afterwards
-        assert rpc_get((host, port), "/health")["result"] == {}
+        assert rpc_get((host, port), "/health")["result"]["healthy"] is True
 
         # -- connection flood: at most MAX_OPEN_CONNECTIONS serviced --
         old_cap = rpcmod.MAX_OPEN_CONNECTIONS
@@ -476,6 +480,6 @@ def test_rpc_hardening_body_cap_and_connection_cap():
                 sem.release()
         # normal service restored
         time.sleep(0.1)
-        assert rpc_get((host, port), "/health")["result"] == {}
+        assert rpc_get((host, port), "/health")["result"]["healthy"] is True
     finally:
         net.stop()

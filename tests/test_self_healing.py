@@ -1,0 +1,309 @@
+"""Self-healing liveness layer driven against live LocalNets.
+
+The tentpole scenario: a seeded chaos partition heals MID-RUN and every
+node reaches commit parity with the anti-entropy re-walk disabled — so
+recovery is attributable to the health layer (quorum-stall watchdog
+re-offers + peer-score-driven evict/reconnect cycles), with zero node
+restarts. Satellites: consensus-channel chaos liveness, crash-under-chaos
+exactly-once replay, and verifier-counter surfacing over RPC.
+"""
+
+import collections
+import hashlib
+import json
+import time
+import urllib.request
+
+import pytest
+
+from txflow_tpu.abci.kvstore import KVStoreApplication
+from txflow_tpu.faults import ChaosRouter, FaultSpec, FlakyVerifier
+from txflow_tpu.health import HealthConfig
+from txflow_tpu.node.localnet import LocalNet
+from txflow_tpu.types import MockPV, Validator, ValidatorSet
+from txflow_tpu.utils.config import test_config as make_test_config
+from txflow_tpu.verifier import ResilientVoteVerifier, ScalarVoteVerifier
+
+
+def wait_until(pred, timeout=20.0, poll=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    return False
+
+
+def rpc_get(addr, path):
+    host, port = addr
+    with urllib.request.urlopen(f"http://{host}:{port}{path}", timeout=30) as r:
+        return json.loads(r.read().decode())
+
+
+# an aggressive profile so the drills resolve in seconds: fast ticks,
+# quick staleness, shallow eviction floor, sub-second reconnect backoff
+FAST_HEAL = HealthConfig(
+    tick_interval=0.1,
+    stall_timeout=0.8,
+    stale_after=0.6,
+    min_sends_for_stale=2,
+    score_floor=-2.0,
+    reconnect_base=0.2,
+    reconnect_cap=1.0,
+    seed=7,
+)
+
+
+# ------------------------------------------------ tentpole acceptance
+
+
+def test_partition_heals_via_watchdog_and_reconnects():
+    """2/2 partition starves quorum on both sides; after heal() the net
+    reaches commit parity WITHOUT the reactors' anti-entropy re-walk
+    (regossip effectively off) and without restarting any node: the
+    stall watchdog re-offers votes+txs past sender suppression, and
+    peer scoring evicts the black-holed links and re-dials them."""
+    chaos = ChaosRouter(FaultSpec(seed=11))
+    net = LocalNet(
+        4,
+        use_device_verifier=False,
+        fault_plan=chaos,
+        regossip_interval=60.0,  # longer than the test: health layer only
+        health_config=FAST_HEAL,
+    )
+    net.start()
+    try:
+        pre = b"pre-partition=v"
+        net.broadcast_tx(pre)
+        assert net.wait_all_committed([pre], timeout=30)
+
+        chaos.partition({"node0", "node1"})  # node2/node3: implicit group
+        cut = [b"cut-%d=v" % i for i in range(5)]
+        for tx in cut:
+            net.broadcast_tx(tx, node_index=0)
+
+        # both sides hold < 2/3 stake: the txs stall below quorum and the
+        # watchdog + peer scorer must light up while the cut holds
+        assert wait_until(
+            lambda: net.nodes[0].health.snapshot()["watchdog"]["firings"] > 0
+            and net.nodes[0].health.snapshot()["peers"]["evictions"] > 0,
+            timeout=15,
+        ), net.nodes[0].health.snapshot()
+        # degradation is visible: stall onset age keeps growing past the
+        # watchdog's own re-arm, flipping the liveness verdict
+        assert wait_until(
+            lambda: not net.nodes[0].health.snapshot()["healthy"], timeout=15
+        )
+        assert chaos.stats["partitioned"] > 0
+
+        chaos.heal()
+        assert net.wait_all_committed(cut, timeout=60), (
+            "health layer must carry the backlog after heal",
+            [n.health.snapshot() for n in net.nodes],
+        )
+        # acceptance: nonzero watchdog firings and score-driven reconnect
+        # cycles, observed on a cut-side node, with no restarts
+        snap = net.nodes[0].health.snapshot()
+        assert snap["watchdog"]["firings"] > 0
+        assert snap["peers"]["evictions"] > 0
+        total_reconnects = sum(
+            n.health.snapshot()["peers"]["reconnects"] for n in net.nodes
+        )
+        assert total_reconnects > 0
+        assert all(n._started for n in net.nodes), "no node may restart"
+        # the stalls resolved: verdict recovers on every node
+        assert wait_until(
+            lambda: all(n.health.snapshot()["healthy"] for n in net.nodes),
+            timeout=15,
+        )
+    finally:
+        net.stop()
+
+
+# --------------------------------- satellite: consensus-channel chaos
+
+
+def test_consensus_channel_chaos_block_liveness():
+    """FaultSpec(channels=None) extends chaos over the consensus channel
+    (0x20): dropped push-once state-machine messages are recovered by BFT
+    round timeouts, so block production must stay live within the spec's
+    own liveness_budget."""
+    spec = FaultSpec(
+        seed=23,
+        drop=0.05,
+        delay=0.10,
+        delay_max=0.03,
+        channels=None,  # every channel, consensus included
+        liveness_budget=90.0,
+    )
+    cfg = make_test_config()
+    cfg.consensus.skip_timeout_commit = True
+    net = LocalNet(
+        4,
+        use_device_verifier=False,
+        enable_consensus=True,
+        config=cfg,
+        fault_plan=spec,
+        health_config=FAST_HEAL,
+    )
+    net.start()
+    try:
+        txs = [b"cons-chaos-%d=v" % i for i in range(4)]
+        for tx in txs:
+            net.broadcast_tx(tx)
+        assert net.wait_all_committed(txs, timeout=spec.liveness_budget)
+        for node in net.nodes:
+            assert node.consensus.wait_for_height(2, timeout=spec.liveness_budget), (
+                "block production must stay live under consensus-channel chaos"
+            )
+    finally:
+        net.stop()
+
+
+# ------------------------------------ satellite: crash under chaos
+
+
+class CountingKVStore(KVStoreApplication):
+    """kvstore recording every delivered tx (exactly-once oracle)."""
+
+    def __init__(self):
+        super().__init__()
+        self.delivered = collections.Counter()
+
+    def deliver_tx(self, tx):
+        self.delivered[bytes(tx)] += 1
+        return super().deliver_tx(tx)
+
+
+def test_crash_and_revive_member_under_chaos(tmp_path):
+    """CrashDrill-style kill/rebuild of a durable LocalNet member while a
+    FaultPlan keeps dropping/delaying gossip: the revived node rebuilds a
+    FRESH app by handshake replay + block catchup, delivering every tx
+    exactly once, with the pre-crash commit order as a prefix."""
+    cfg = make_test_config()
+    cfg.consensus.skip_timeout_commit = True
+    net = LocalNet(
+        4,
+        use_device_verifier=False,
+        enable_consensus=True,
+        config=cfg,
+        app_factory=CountingKVStore,
+        fault_plan=FaultSpec(seed=31, drop=0.05, delay=0.10, delay_max=0.02),
+        health_config=FAST_HEAL,
+    )
+    net.make_durable(2, str(tmp_path))
+    net.start()
+    try:
+        wave1 = [b"pre-crash-%d=v" % i for i in range(4)]
+        for tx in wave1:
+            net.broadcast_tx(tx)
+        assert net.wait_all_committed(wave1, timeout=60)
+        pre_order = net.nodes[2].tx_store.committed_hashes_in_order()
+        assert len(pre_order) >= len(wave1)
+
+        net.crash_node(2)
+        # the survivors hold 3/4 stake: load continues through the outage
+        wave2 = [b"mid-crash-%d=v" % i for i in range(4)]
+        for tx in wave2:
+            net.broadcast_tx(tx, node_index=0)
+        survivors = [net.nodes[i] for i in (0, 1, 3)]
+        deadline = time.monotonic() + 60
+        for node in survivors:
+            for tx in wave2:
+                h = hashlib.sha256(tx).hexdigest().upper()
+                while not node.tx_store.has_tx(h):
+                    assert time.monotonic() < deadline, "survivors stalled"
+                    time.sleep(0.01)
+
+        # revive_node rebuilds with a FRESH app and start() immediately
+        # handshake-replays the persisted blocks into it — so by the time
+        # it returns, wave1 is already (re)delivered, exactly once
+        revived = net.revive_node(2)
+        assert revived.app is not net.nodes[2] and revived is net.nodes[2]
+        assert net.wait_all_committed(wave1 + wave2, timeout=90), (
+            "revived node must converge under active chaos"
+        )
+        # exactly-once: replay + catchup delivered every tx once
+        for tx in wave1 + wave2:
+            assert revived.app.delivered[tx] == 1, (tx, revived.app.delivered)
+        assert not [t for t, c in revived.app.delivered.items() if c > 1]
+        # commit-order convergence: what node2 had persisted before the
+        # crash is a strict prefix of its post-revival order
+        post_order = revived.tx_store.committed_hashes_in_order()
+        assert post_order[: len(pre_order)] == pre_order
+    finally:
+        net.stop()
+
+
+# --------------------------- satellite: verifier counters over RPC
+
+
+def test_verifier_counters_surface_in_health_and_status():
+    """A demoted ResilientVoteVerifier's counters flow through the
+    degraded-mode registry into /health, /status and the metrics
+    gauges."""
+    pvs = [
+        MockPV(hashlib.sha256(b"health-val%d" % i).digest()) for i in range(4)
+    ]
+    vs = ValidatorSet([Validator.from_pub_key(pv.get_pub_key(), 10) for pv in pvs])
+    flaky = FlakyVerifier(ScalarVoteVerifier(vs))
+    flaky.failing = True  # device down for the whole test
+    resilient = ResilientVoteVerifier(
+        flaky,
+        fallback=ScalarVoteVerifier(vs),
+        max_attempts=2,
+        backoff_base=0.001,
+        probe_interval=3600.0,  # stay demoted for the whole test
+    )
+    net = LocalNet(
+        4,
+        use_device_verifier=False,
+        priv_vals=pvs,
+        verifier=resilient,
+        rpc=True,
+        health_config=HealthConfig(tick_interval=0.05),
+    )
+    net.start()
+    try:
+        txs = [b"vrf-%d=v" % i for i in range(3)]
+        for tx in txs:
+            net.broadcast_tx(tx)
+        assert net.wait_all_committed(txs, timeout=60), (
+            "CPU fallback must keep commits flowing"
+        )
+        assert resilient.demotions >= 1
+
+        def surfaced():
+            h = rpc_get(net.nodes[0].rpc.addr, "/health")["result"]
+            v = h.get("verifier") or {}
+            return v.get("device_healthy") is False and v.get("demotions", 0) >= 1
+
+        assert wait_until(surfaced, timeout=10)
+        health = rpc_get(net.nodes[0].rpc.addr, "/health")["result"]
+        v = health["verifier"]
+        assert v["fallback_calls"] >= 1 and v["device_failures"] >= 1
+        assert "injected device failure" in (v["last_error"] or "")
+        status = rpc_get(net.nodes[0].rpc.addr, "/status")["result"]
+        assert status["health"]["monitored"] is True
+        assert status["health"]["verifier"]["demotions"] >= 1
+        # and the Prometheus-side gauges agree
+        m = net.nodes[0].health.registry.metrics
+        assert m.verifier_demotions.value() >= 1
+        assert m.verifier_device_healthy.value() == 0.0
+    finally:
+        net.stop()
+
+
+# ------------------------------------------- health off-switch sanity
+
+
+def test_health_disabled_node_runs_without_monitor():
+    net = LocalNet(2, use_device_verifier=False, health=False)
+    net.start()
+    try:
+        assert all(n.health is None for n in net.nodes)
+        tx = b"nohealth=v"
+        net.broadcast_tx(tx)
+        assert net.wait_all_committed([tx], timeout=30)
+    finally:
+        net.stop()

@@ -1,0 +1,195 @@
+"""WAL recovery edge cases: torn tails, corrupted records, garbage.
+
+The CRC-framed WAL (utils/wal.py) promises: replay yields every intact
+frame up to the first torn/corrupt one, then TRUNCATES the file there so
+future appends restart on a frame boundary — never raising, never
+resurrecting bytes past the damage. The consensus WAL layers a typed
+JSON envelope on top and must tolerate frames whose CRC is fine but
+whose payload no longer decodes.
+"""
+
+import struct
+import zlib
+
+from txflow_tpu.consensus.ticker import TimeoutInfo
+from txflow_tpu.consensus.wal import ConsensusWAL
+from txflow_tpu.utils.wal import _HDR, WAL
+
+# ----------------------------------------------------------- utils.wal
+
+
+def write_frames(path, payloads):
+    w = WAL(str(path))
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+
+def test_replay_truncated_tail_mid_payload(tmp_path):
+    """Crash mid-append: the partial last frame is dropped and the file
+    is truncated back to the last intact frame boundary."""
+    path = tmp_path / "torn.wal"
+    write_frames(path, [b"one", b"two", b"three"])
+    whole = path.read_bytes()
+    path.write_bytes(whole[:-2])  # tear 2 bytes off the last payload
+
+    w = WAL(str(path))
+    assert list(w.replay()) == [b"one", b"two"]
+    # truncated to the good prefix: a fresh append lands on a boundary
+    w.write(b"four")
+    assert list(w.replay()) == [b"one", b"two", b"four"]
+    w.close()
+
+
+def test_replay_truncated_tail_mid_header(tmp_path):
+    """Tear inside the 8-byte header itself (crash between header and
+    payload writes)."""
+    path = tmp_path / "torn-hdr.wal"
+    write_frames(path, [b"alpha"])
+    w = WAL(str(path))
+    w.write(b"beta")
+    w.close()
+    whole = path.read_bytes()
+    # keep frame 1 + only 3 bytes of frame 2's header
+    keep = _HDR.size + 5 + 3
+    path.write_bytes(whole[:keep])
+
+    w = WAL(str(path))
+    assert list(w.replay()) == [b"alpha"]
+    assert w.size == _HDR.size + 5  # header fragment truncated away
+    w.close()
+
+
+def test_replay_corrupted_record_crc_mismatch(tmp_path):
+    """Bit rot inside a middle record: everything from the corrupt frame
+    on is dropped — a CRC break means frame boundaries can no longer be
+    trusted, so later (intact-looking) frames must NOT be resurrected."""
+    path = tmp_path / "rot.wal"
+    write_frames(path, [b"good-1", b"good-2", b"good-3"])
+    raw = bytearray(path.read_bytes())
+    # flip one payload byte of the SECOND frame (header stays valid)
+    second_payload_at = (_HDR.size + 6) + _HDR.size
+    raw[second_payload_at] ^= 0xFF
+    path.write_bytes(bytes(raw))
+
+    w = WAL(str(path))
+    assert list(w.replay()) == [b"good-1"]
+    assert w.size == _HDR.size + 6
+    w.close()
+
+
+def test_replay_garbage_header_claims_absurd_length(tmp_path):
+    """A header whose length field exceeds the file: treated as torn."""
+    path = tmp_path / "absurd.wal"
+    write_frames(path, [b"ok"])
+    with open(path, "ab") as f:
+        f.write(_HDR.pack(zlib.crc32(b"x"), 1 << 30))  # 1 GiB claim
+
+    w = WAL(str(path))
+    assert list(w.replay()) == [b"ok"]
+    assert w.size == _HDR.size + 2
+    w.close()
+
+
+def test_replay_empty_and_pure_garbage_files(tmp_path):
+    empty = WAL(str(tmp_path / "empty.wal"))
+    assert list(empty.replay()) == []
+    empty.close()
+
+    garbage = tmp_path / "garbage.wal"
+    garbage.write_bytes(b"\x00\x01\x02 not a wal at all")
+    w = WAL(str(garbage))
+    assert list(w.replay()) == []
+    assert w.size == 0  # truncated to nothing
+    w.close()
+
+
+def test_replay_is_idempotent_after_truncate(tmp_path):
+    path = tmp_path / "idem.wal"
+    write_frames(path, [b"a", b"b"])
+    with open(path, "ab") as f:
+        f.write(b"\xde\xad")  # torn tail
+    w = WAL(str(path))
+    assert list(w.replay()) == [b"a", b"b"]
+    assert list(w.replay()) == [b"a", b"b"]  # second pass: already clean
+    w.close()
+
+
+# ------------------------------------------------------ consensus WAL
+
+
+def test_consensus_wal_skips_undecodable_payload_frames(tmp_path):
+    """A frame with a VALID CRC but a payload that no longer decodes as a
+    WAL message (e.g. written by a newer version) is skipped per-frame;
+    surrounding messages survive."""
+    path = tmp_path / "consensus.wal"
+    cw = ConsensusWAL(str(path))
+    cw.write_timeout(TimeoutInfo(duration=0.1, height=5, round=0, step=1))
+    cw.wal.write(b"{json but not a wal message}")
+    cw.wal.write(b'{"t": "unknown-kind", "x": 1}')
+    cw.write_timeout(TimeoutInfo(duration=0.2, height=5, round=1, step=2))
+    cw.close()
+
+    cw = ConsensusWAL(str(path))
+    msgs = cw.messages_after_end_height(5)
+    assert [k for k, _ in msgs] == ["timeout", "timeout"]
+    assert msgs[0][1].height == 5 and msgs[1][1].round == 1
+    cw.close()
+
+
+def test_consensus_wal_torn_tail_recovers_to_marker(tmp_path):
+    """Crash right after the EndHeight fsync but mid-write of the next
+    message: replay anchors at the marker and the torn frame vanishes."""
+    path = tmp_path / "torn-consensus.wal"
+    cw = ConsensusWAL(str(path))
+    cw.write_timeout(TimeoutInfo(duration=0.1, height=7, round=0, step=1))
+    cw.write_end_height(7)
+    cw.write_timeout(TimeoutInfo(duration=0.1, height=8, round=0, step=1))
+    cw.close()
+    raw = path.read_bytes()
+    path.write_bytes(raw[:-3])  # tear the post-marker message
+
+    cw = ConsensusWAL(str(path))
+    assert cw.messages_after_end_height(7) == []
+    # the file healed: appends resume cleanly on the frame boundary
+    cw.write_timeout(TimeoutInfo(duration=0.1, height=8, round=1, step=1))
+    cw.close()
+    cw = ConsensusWAL(str(path))
+    msgs = cw.messages_after_end_height(7)
+    assert len(msgs) == 1 and msgs[0][1].round == 1
+    cw.close()
+
+
+def test_consensus_wal_corrupt_record_before_marker(tmp_path):
+    """Corruption BEFORE the last EndHeight marker also kills the marker
+    (frame boundaries after the damage are untrusted): catchup replays
+    the surviving prefix instead of wrongly trusting a later anchor."""
+    path = tmp_path / "pre-marker.wal"
+    cw = ConsensusWAL(str(path))
+    cw.write_timeout(TimeoutInfo(duration=0.1, height=3, round=0, step=1))
+    first_len = cw.wal.size
+    cw.write_timeout(TimeoutInfo(duration=0.2, height=3, round=1, step=1))
+    cw.write_end_height(3)
+    cw.close()
+    raw = bytearray(path.read_bytes())
+    raw[first_len + _HDR.size] ^= 0xFF  # corrupt the second message
+    path.write_bytes(bytes(raw))
+
+    cw = ConsensusWAL(str(path))
+    msgs = cw.messages_after_end_height(3)
+    assert [k for k, _ in msgs] == ["timeout"]
+    assert msgs[0][1].round == 0
+    cw.close()
+
+
+def test_timeout_info_roundtrip_fields(tmp_path):
+    path = tmp_path / "fields.wal"
+    cw = ConsensusWAL(str(path))
+    ti = TimeoutInfo(duration=1.5, height=42, round=3, step=2)
+    cw.write_timeout(ti)
+    cw.close()
+    cw = ConsensusWAL(str(path))
+    [(kind, got)] = cw.messages_after_end_height(42)
+    assert kind == "timeout"
+    assert (got.duration, got.height, got.round, got.step) == (1.5, 42, 3, 2)
+    cw.close()

@@ -6,10 +6,14 @@ full stack the way a flaky validator set would — fast path + block
 ticker, hostile votes (bad sig, unknown validator, oversized fields),
 repeated partitions and heals — then checks for forks, stalls, and leaks.
 Usage: JAX_PLATFORMS=cpu python tools/soak.py [seconds] [--rotate] [--restart]
+                                              [--smoke]
 --restart periodically stops one durable node, rebuilds it over its
 artifacts (fresh app, handshake replay + catchup), and reconnects it —
 the restart x partition x load interleaving that exposed the r5
 replay-deferral bug.
+--smoke: CI-sized run — ~10s of churn with tight quiescence deadlines,
+exiting nonzero with a SOAK STALL banner if convergence misses them;
+wire it into a pipeline as a cheap liveness canary.
 """
 
 import os
@@ -37,7 +41,17 @@ def main() -> None:
 
     jax.config.update("jax_platforms", "cpu")
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
-    duration = float(args[0]) if args else 120.0
+    smoke = "--smoke" in sys.argv
+    duration = float(args[0]) if args else (10.0 if smoke else 120.0)
+    # quiescence budgets: smoke runs must fail FAST on a stall, not sit
+    # in a 2-minute wait — a stalled 10s run is the signal, after all
+    commit_wait = 30.0 if smoke else 120.0
+    height_wait = 15.0 if smoke else 60.0
+
+    def stall(msg: str) -> None:
+        print(f"SOAK STALL: {msg}", flush=True)
+        sys.exit(1)
+
     rng = random.Random(1234)
     cfg = test_config()
     cfg.consensus.skip_timeout_commit = True
@@ -169,15 +183,18 @@ def main() -> None:
         if cut is not None:
             connect_switches(net.nodes[cut[0]].switch, net.nodes[cut[1]].switch)
         tail = sent[-200:]
-        ok = net.wait_all_committed(tail, timeout=120)
-        assert ok, "tail txs failed to commit after heal"
+        ok = net.wait_all_committed(tail, timeout=commit_wait)
+        if not ok:
+            stall(f"tail txs failed to commit within {commit_wait:.0f}s of heal")
         heights = [n.consensus.state.last_block_height for n in net.nodes]
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + height_wait
         while time.monotonic() < deadline:
             heights = [n.consensus.state.last_block_height for n in net.nodes]
             if max(heights) - min(heights) <= 1:
                 break
             time.sleep(0.2)
+        else:
+            stall(f"block heights diverged past deadline: {heights}")
         h = min(heights)
         if h > 0:
             b0 = net.nodes[0].block_store.load_block(h)

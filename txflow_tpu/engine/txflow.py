@@ -615,6 +615,15 @@ class TxFlow:
             with self._mtx:  # racing claim_vtx's locked increment
                 self._applied_count += 1
 
+    def inflight_snapshot(self) -> list[tuple[str, int]]:
+        """(tx_hash, stake) for every tx still aggregating below quorum —
+        the quorum-stall watchdog's progress signal (health/watchdog.py).
+        TxVoteSet.stake() takes the per-set lock, so read it outside the
+        engine lock to keep the snapshot cheap under load."""
+        with self._mtx:
+            sets = list(self.vote_sets.values())
+        return [(vs.tx_hash, vs.stake()) for vs in sets]
+
     def is_tx_committed(self, tx_hash: str) -> bool:
         """Committed via EITHER path: the fast path (TxStore certificate)
         or a block that carried it (engine claim mark). A tx reaped into a

@@ -44,6 +44,13 @@ class FaultSpec:
     A duplicate delivers the original immediately AND schedules a delayed
     copy; a delay defers the original — both produce reordering relative
     to messages sent after them on the same link.
+
+    ``liveness_budget`` is the spec's own timeout allowance: when chaos
+    covers the consensus channels (channels=None or any of 0x20-0x22),
+    dropped push-once state-machine messages are only recovered by BFT
+    round timeouts, so "the net still commits" is a claim about THIS many
+    seconds, not the gossip-path defaults. Harnesses (and tests) should
+    bound their waits with it instead of inventing per-test deadlines.
     """
 
     seed: int = 0
@@ -53,6 +60,7 @@ class FaultSpec:
     delay_min: float = 0.005
     delay_max: float = 0.05
     channels: frozenset = GOSSIP_CHANNELS  # None = every channel
+    liveness_budget: float = 30.0
 
     def __post_init__(self):
         total = self.drop + self.duplicate + self.delay
@@ -60,6 +68,8 @@ class FaultSpec:
             raise ValueError(f"fault probabilities sum to {total}, need [0, 1]")
         if self.delay_min < 0 or self.delay_max < self.delay_min:
             raise ValueError("need 0 <= delay_min <= delay_max")
+        if self.liveness_budget <= 0:
+            raise ValueError("liveness_budget must be positive")
 
 
 class FaultPlan:

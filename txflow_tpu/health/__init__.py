@@ -1,0 +1,30 @@
+"""Self-healing liveness layer (PR 2).
+
+The fast path's liveness rests entirely on the asynchronous TxVote flood
+reaching 2n/3 stake — there are no view changes to fall back on. This
+package detects stalls and heals them without restarts:
+
+- ``HealthMonitor``: per-node driver thread (monitor.py);
+- ``QuorumStallWatchdog``: sub-quorum deadline -> targeted re-offers
+  (watchdog.py);
+- ``PeerScoreBoard``: peer scoring -> eviction + backoff reconnects
+  (peers.py);
+- ``DegradedModeRegistry``: metrics + the RPC /health payload
+  (registry.py);
+- ``HealthConfig``: the tunables (config.py).
+"""
+
+from .config import HealthConfig
+from .monitor import HealthMonitor
+from .peers import PeerScoreBoard, PeerScoreError
+from .registry import DegradedModeRegistry
+from .watchdog import QuorumStallWatchdog
+
+__all__ = [
+    "HealthConfig",
+    "HealthMonitor",
+    "PeerScoreBoard",
+    "PeerScoreError",
+    "DegradedModeRegistry",
+    "QuorumStallWatchdog",
+]

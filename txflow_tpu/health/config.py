@@ -1,0 +1,50 @@
+"""HealthConfig: tunables for the self-healing liveness layer.
+
+One dataclass covers all three health drives (monitor cadence, quorum-
+stall watchdog, peer scoring + reconnect backoff) so a node assembly or a
+chaos rig can swap the whole posture at once. Defaults are conservative:
+on a healthy in-proc net the watchdog never fires (quorum forms in
+milliseconds, the deadline is seconds) and peer scoring never evicts
+(eviction additionally requires a reconnector — see peers.py — so a
+plain node without reconnect wiring can only observe, never amputate).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class HealthConfig:
+    # monitor cadence: one tick drives the watchdog, the peer scorer and
+    # the degraded-mode gauge refresh
+    tick_interval: float = 0.25
+
+    # -- quorum-stall watchdog --
+    watchdog: bool = True
+    # a tx below 2n/3 whose stake has not advanced for this long is
+    # stalled; each firing re-offers its known votes + tx bytes, and the
+    # timer re-arms so escalation happens one deadline later
+    stall_timeout: float = 2.0
+    # escalation: firing 0 targets ONE peer (round-robin); later firings
+    # for the same stuck tx target every peer
+    max_reoffer_votes: int = 512  # votes per re-offer frame
+
+    # -- peer scoring --
+    peer_scoring: bool = True
+    # staleness: nothing received from the peer for this long WHILE we
+    # kept handing it frames (a quiet idle link is not stale)
+    stale_after: float = 2.0
+    min_sends_for_stale: int = 3
+    send_fail_penalty: float = 2.0  # per failed send (transport/backpressure)
+    stale_penalty: float = 1.0  # per tick while stale
+    dup_penalty: float = 0.02  # per duplicate beyond fresh traffic
+    recv_reward: float = 0.5  # per tick with inbound progress
+    score_max: float = 4.0  # reward ceiling
+    score_floor: float = -8.0  # at/below: evict (if a reconnector is wired)
+
+    # -- reconnect backoff (jittered, capped exponential) --
+    reconnect_base: float = 0.25
+    reconnect_cap: float = 5.0
+    reconnect_jitter: float = 0.25  # uniform +-fraction of the delay
+    seed: int = 0  # jitter PRNG seed (deterministic drills)

@@ -1,0 +1,92 @@
+"""HealthMonitor: the per-node self-healing driver.
+
+One background thread ticks three drives against the node's live
+surfaces:
+
+1. the quorum-stall watchdog (engine in-flight map -> targeted vote/tx
+   re-offers, watchdog.py);
+2. the peer scorer (Peer.stats deltas -> eviction + backoff reconnects,
+   peers.py);
+3. the degraded-mode registry refresh (verifier counters, progress
+   cursors, churn totals -> metrics gauges + the /health snapshot,
+   registry.py).
+
+The monitor is assembly-owned (Node builds one when
+``NodeConfig.health``), holds no protocol state, and can be stopped or
+never started without affecting the data path — healing is strictly
+additive: re-offers are dedup'd by receivers, evictions require a
+reconnector, and all reads are thread-safe node surfaces.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from .config import HealthConfig
+from .peers import PeerScoreBoard
+from .registry import DegradedModeRegistry
+from .watchdog import QuorumStallWatchdog
+
+
+class HealthMonitor:
+    def __init__(self, node, cfg: HealthConfig | None = None):
+        self.node = node
+        self.cfg = cfg or HealthConfig()
+        self.registry = DegradedModeRegistry(node.metrics_registry)
+        self.registry._stall_timeout_hint = self.cfg.stall_timeout
+        self.scoreboard = PeerScoreBoard(node.switch, self.cfg, self.registry)
+        self.watchdog = QuorumStallWatchdog(
+            node.txflow,
+            node.tx_vote_pool,
+            node.mempool,
+            node.switch,
+            self.cfg,
+            self.registry,
+        )
+        self._running = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def set_reconnector(self, fn: Callable[[str], bool] | None) -> None:
+        """Wire the re-dial hook; eviction stays disabled without one."""
+        self.scoreboard.reconnector = fn
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        if self._running.is_set():
+            return
+        self._running.set()
+        self._thread = threading.Thread(
+            target=self._run, name=f"health-{self.node.node_id}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running.clear()
+        t = self._thread
+        self._thread = None
+        if t is not None:
+            t.join(timeout=2)
+
+    def _run(self) -> None:
+        cfg = self.cfg
+        while self._running.is_set():
+            now = time.monotonic()
+            try:
+                if cfg.watchdog:
+                    self.watchdog.tick(now)
+                if cfg.peer_scoring:
+                    self.scoreboard.tick(now)
+                self.registry.refresh(self.node)
+            except Exception:
+                # the healer must never kill itself on a transient race
+                # with node shutdown; next tick re-reads everything
+                pass
+            time.sleep(cfg.tick_interval)
+
+    # -- operator surface --
+
+    def snapshot(self) -> dict:
+        return self.registry.snapshot(peer_scores=self.scoreboard.scores())

@@ -1,0 +1,176 @@
+"""Peer scoring + reconnect backoff: the switch's fire-and-forget peer
+set becomes managed.
+
+Score inputs per tick (deltas of ``Peer.stats``, bumped lock-free by the
+switch's send/recv loops and the gossip reactors):
+
+- send failures (transport error or queue-full backpressure): large
+  penalty — the peer is not draining;
+- staleness: nothing received for ``stale_after`` while we kept handing
+  the peer frames (quiet idle links are NOT stale; a black-holed link —
+  e.g. a chaos partition, where the sender sees success — is);
+- duplicate deliveries in excess of fresh traffic: small penalty (gossip
+  legitimately delivers each vote 2-3x via independent forwarders);
+- inbound progress: reward, capped.
+
+At/below ``score_floor`` the peer is evicted — but ONLY when a
+reconnector is wired: an eviction without a way back would turn one bad
+interval into a permanent amputation, so an unwired node observes scores
+without acting on them. Evicted peers re-dial on a jittered, capped
+exponential backoff; the backoff level resets once a reconnected peer
+shows inbound progress again.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable
+
+from .config import HealthConfig
+from .registry import DegradedModeRegistry
+
+
+class PeerScoreError(Exception):
+    """Eviction reason handed to Switch.stop_peer (shows in peer logs)."""
+
+
+class _PeerTrack:
+    __slots__ = (
+        "score",
+        "send_attempts",
+        "send_fail",
+        "recv_count",
+        "duplicates",
+        "last_progress",
+        "sends_since_progress",
+    )
+
+    def __init__(self, now: float):
+        self.score = 0.0
+        self.send_attempts = 0
+        self.send_fail = 0
+        self.recv_count = 0
+        self.duplicates = 0
+        self.last_progress = now
+        self.sends_since_progress = 0
+
+
+class PeerScoreBoard:
+    def __init__(
+        self,
+        switch,
+        cfg: HealthConfig,
+        registry: DegradedModeRegistry,
+        reconnector: Callable[[str], bool] | None = None,
+    ):
+        self.switch = switch
+        self.cfg = cfg
+        self.registry = registry
+        # reconnector(node_id) -> bool: re-establish the link to node_id.
+        # LocalNet wires in-memory re-pipes; a TCP assembly would wire a
+        # dial through its address book.
+        self.reconnector = reconnector
+        self._tracks: dict[str, _PeerTrack] = {}
+        self._backoff_level: dict[str, int] = {}
+        self._pending: dict[str, float] = {}  # node_id -> reconnect due time
+        self._rng = random.Random(cfg.seed)
+
+    # -- scoring --
+
+    def scores(self) -> dict[str, float]:
+        return {nid: round(t.score, 2) for nid, t in self._tracks.items()}
+
+    def tick(self, now: float | None = None) -> None:
+        if now is None:
+            now = time.monotonic()
+        cfg = self.cfg
+        peers = self.switch.peers()
+        live_ids = set()
+        for peer in peers:
+            nid = peer.node_id
+            live_ids.add(nid)
+            tr = self._tracks.get(nid)
+            if tr is None:
+                tr = self._tracks[nid] = _PeerTrack(now)
+            st = peer.stats
+            # snapshot-and-diff: the loops bump ints without locks.
+            # Staleness tracks send ATTEMPTS (pre-interception), because
+            # a chaos-partitioned link black-holes frames while reporting
+            # success — attempts are the proof we kept talking
+            attempts, send_fail = st.send_attempts, st.send_fail
+            recv_count, dups = st.recv_count, st.duplicates
+            d_att = attempts - tr.send_attempts
+            d_fail = send_fail - tr.send_fail
+            d_recv = recv_count - tr.recv_count
+            d_dup = dups - tr.duplicates
+            tr.send_attempts, tr.send_fail = attempts, send_fail
+            tr.recv_count, tr.duplicates = recv_count, dups
+            delta = -cfg.send_fail_penalty * d_fail
+            delta -= cfg.dup_penalty * max(0, d_dup - max(d_recv - d_dup, 0))
+            if d_recv > 0:
+                delta += cfg.recv_reward
+                tr.last_progress = now
+                tr.sends_since_progress = 0
+                # inbound progress after a reconnect clears the penalty
+                self._backoff_level.pop(nid, None)
+            else:
+                tr.sends_since_progress += d_att
+            if (
+                now - tr.last_progress > cfg.stale_after
+                and tr.sends_since_progress >= cfg.min_sends_for_stale
+            ):
+                delta -= cfg.stale_penalty
+            tr.score = min(cfg.score_max, tr.score + delta)
+            if tr.score <= cfg.score_floor and self.reconnector is not None:
+                self._evict(peer, now)
+                live_ids.discard(nid)  # evicted this tick: not live
+        # forget tracks for peers that left by other causes; their backoff
+        # level survives so a flapping peer keeps its penalty
+        for nid in list(self._tracks):
+            if nid not in live_ids:
+                del self._tracks[nid]
+        self._drain_reconnects(now)
+
+    # -- eviction + reconnect --
+
+    def _evict(self, peer, now: float) -> None:
+        nid = peer.node_id
+        self._tracks.pop(nid, None)
+        level = self._backoff_level.get(nid, 0)
+        self._backoff_level[nid] = level + 1
+        self.switch.stop_peer(peer, reason=PeerScoreError(f"score floor ({nid})"))
+        self.registry.note_peer_evicted()
+        self._pending[nid] = now + self._backoff_delay(level)
+
+    def _backoff_delay(self, level: int) -> float:
+        cfg = self.cfg
+        base = min(cfg.reconnect_base * (2.0**level), cfg.reconnect_cap)
+        jitter = 1.0 + cfg.reconnect_jitter * (2.0 * self._rng.random() - 1.0)
+        return base * jitter
+
+    def _drain_reconnects(self, now: float) -> None:
+        for nid, due in list(self._pending.items()):
+            if self.switch.get_peer(nid) is not None:
+                # reconnected some other way (inbound dial, operator)
+                del self._pending[nid]
+                continue
+            if now < due:
+                continue
+            ok = False
+            if self.reconnector is not None:
+                try:
+                    ok = bool(self.reconnector(nid))
+                except Exception:
+                    ok = False
+            if ok:
+                # a fresh track starts at score 0; the backoff level only
+                # resets once the reconnected peer shows inbound progress
+                # again (tick() clears it on the first d_recv > 0)
+                del self._pending[nid]
+                self.registry.note_peer_reconnected()
+            else:
+                self.registry.note_reconnect_failed()
+                level = self._backoff_level.get(nid, 1)
+                self._backoff_level[nid] = level + 1
+                self._pending[nid] = now + self._backoff_delay(level)

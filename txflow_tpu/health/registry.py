@@ -1,0 +1,166 @@
+"""DegradedModeRegistry: one aggregation point for "how degraded is this
+node" — ResilientVoteVerifier counters, quorum-stall watchdog firings,
+and peer churn — mirrored into ``utils.metrics`` health gauges and
+snapshotted as the RPC ``/health`` payload.
+
+The registry owns no threads: the HealthMonitor tick calls ``refresh``
+and the watchdog / peer scorer call the ``note_*`` event hooks. Events
+are double-counted on purpose into both plain ints (cheap snapshot) and
+the metrics registry (Prometheus exposition) so ``/health`` and
+``/metrics`` can never disagree about totals.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils.metrics import HealthMetrics, Registry
+
+
+class DegradedModeRegistry:
+    def __init__(self, metrics_registry: Registry):
+        self.metrics = HealthMetrics(metrics_registry)
+        self._mtx = threading.Lock()
+        # event totals (watchdog + peer scorer hooks)
+        self.watchdog_firings = 0
+        self.watchdog_escalations = 0
+        self.reoffered_votes = 0
+        self.reoffered_txs = 0
+        self.peer_evictions = 0
+        self.peer_reconnects = 0
+        self.reconnect_failures = 0
+        # state refreshed each tick
+        self._progress: dict = {}
+        self._verifier: dict = {}
+        self._peers: dict = {}
+        self._watchdog_state: dict = {"inflight": 0, "oldest_stall_age": 0.0}
+        self._healthy = True
+
+    # -- event hooks --
+
+    def note_watchdog_fired(self, escalated: bool, votes: int, txs: int) -> None:
+        with self._mtx:
+            self.watchdog_firings += 1
+            if escalated:
+                self.watchdog_escalations += 1
+            self.reoffered_votes += votes
+            self.reoffered_txs += txs
+        m = self.metrics
+        m.watchdog_firings.add(1)
+        if escalated:
+            m.watchdog_escalations.add(1)
+        if votes:
+            m.reoffered_votes.add(votes)
+        if txs:
+            m.reoffered_txs.add(txs)
+
+    def note_peer_evicted(self) -> None:
+        with self._mtx:
+            self.peer_evictions += 1
+        self.metrics.peer_evictions.add(1)
+
+    def note_peer_reconnected(self) -> None:
+        with self._mtx:
+            self.peer_reconnects += 1
+        self.metrics.peer_reconnects.add(1)
+
+    def note_reconnect_failed(self) -> None:
+        with self._mtx:
+            self.reconnect_failures += 1
+        self.metrics.reconnect_failures.add(1)
+
+    # -- tick refresh --
+
+    def set_watchdog_state(self, inflight: int, oldest_stall_age: float) -> None:
+        with self._mtx:
+            self._watchdog_state = {
+                "inflight": inflight,
+                "oldest_stall_age": round(oldest_stall_age, 3),
+            }
+        self.metrics.inflight_txs.set(inflight)
+        self.metrics.oldest_stall_age.set(oldest_stall_age)
+
+    def refresh(self, node) -> None:
+        """Pull the per-subsystem progress signals off the node. Runs on
+        the monitor thread; every read below is a thread-safe node
+        surface (pool seq counters, metrics gauges, switch peer list)."""
+        verifier = getattr(node.txflow, "verifier", None)
+        vstate: dict = {}
+        if verifier is not None and hasattr(verifier, "device_healthy"):
+            vstate = {
+                "device_healthy": bool(verifier.device_healthy),
+                "demotions": verifier.demotions,
+                "repromotions": verifier.repromotions,
+                "device_failures": verifier.device_failures,
+                "fallback_calls": verifier.fallback_calls,
+                "last_error": repr(verifier.last_error)
+                if verifier.last_error is not None
+                else None,
+            }
+            m = self.metrics
+            m.verifier_demotions.set(vstate["demotions"])
+            m.verifier_repromotions.set(vstate["repromotions"])
+            m.verifier_device_failures.set(vstate["device_failures"])
+            m.verifier_fallback_calls.set(vstate["fallback_calls"])
+            m.verifier_device_healthy.set(1.0 if vstate["device_healthy"] else 0.0)
+        n_peers = node.switch.n_peers()
+        self.metrics.n_peers.set(n_peers)
+        progress = {
+            "fast_path_height": node.committed_height_view,
+            "consensus_height": (
+                node.consensus.state.last_block_height
+                if node.consensus is not None
+                else None
+            ),
+            "mempool_seq": node.mempool.seq(),
+            "mempool_size": node.mempool.size(),
+            "txvote_seq": node.tx_vote_pool.seq(),
+            "txvotepool_size": node.tx_vote_pool.size(),
+            "committed_txs": int(node.metrics.committed_txs.value()),
+        }
+        # the liveness verdict: degraded when the device lane is demoted,
+        # a tx has been stalled past ~2 deadlines, or the node has no
+        # peers while work is pending
+        stalled = self._watchdog_state["oldest_stall_age"]
+        healthy = (
+            (not vstate or vstate["device_healthy"])
+            and stalled < 2 * max(self._stall_timeout_hint, 0.001)
+            and not (n_peers == 0 and progress["txvotepool_size"] > 0)
+        )
+        with self._mtx:
+            self._progress = progress
+            self._verifier = vstate
+            self._peers = {"n_peers": n_peers}
+            self._healthy = healthy
+        self.metrics.healthy.set(1.0 if healthy else 0.0)
+
+    _stall_timeout_hint: float = 2.0  # monitor sets this from its config
+
+    # -- snapshots --
+
+    @property
+    def healthy(self) -> bool:
+        with self._mtx:
+            return self._healthy
+
+    def snapshot(self, peer_scores: dict | None = None) -> dict:
+        with self._mtx:
+            return {
+                "healthy": self._healthy,
+                "watchdog": {
+                    "firings": self.watchdog_firings,
+                    "escalations": self.watchdog_escalations,
+                    "reoffered_votes": self.reoffered_votes,
+                    "reoffered_txs": self.reoffered_txs,
+                    **self._watchdog_state,
+                },
+                "peers": {
+                    **self._peers,
+                    "evictions": self.peer_evictions,
+                    "reconnects": self.peer_reconnects,
+                    "reconnect_failures": self.reconnect_failures,
+                    "scores": peer_scores or {},
+                },
+                "verifier": dict(self._verifier),
+                "progress": dict(self._progress),
+            }

@@ -1,0 +1,117 @@
+"""Quorum-stall watchdog: targeted anti-entropy for the fast path.
+
+The paper's fast path has no view changes — a tx whose TxVote flood
+never reaches 2n/3 stake just sits in the engine's in-flight map forever.
+The watchdog detects that (stake not advancing past ``stall_timeout``)
+and re-offers what THIS node knows for the stuck tx — its pool votes
+(pre-serialized wire segments, joined into one MSG_VOTES frame) and the
+raw tx bytes — directly to peers, bypassing the cursor walks' sender
+suppression: the suppressed peer may be exactly the one that lost the
+frame. Escalation: the first firing targets one peer (round-robin);
+while the same tx stays stuck, later firings target every peer. Each
+firing re-arms the deadline, so escalation is paced, not a flood.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..p2p.base import CHANNEL_MEMPOOL, CHANNEL_TXVOTE
+from ..reactors.mempool_reactor import encode_tx_batch
+from ..reactors.txvote_reactor import _MSG_VOTES_B
+from .config import HealthConfig
+from .registry import DegradedModeRegistry
+
+
+class _Stall:
+    __slots__ = ("first", "since", "stake", "level")
+
+    def __init__(self, now: float, stake: int):
+        self.first = now  # stall onset: reported age survives re-arms
+        self.since = now
+        self.stake = stake
+        self.level = 0  # escalation: 0 = one peer, >0 = all peers
+
+
+class QuorumStallWatchdog:
+    def __init__(
+        self,
+        engine,
+        tx_vote_pool,
+        mempool,
+        switch,
+        cfg: HealthConfig,
+        registry: DegradedModeRegistry,
+    ):
+        self.engine = engine
+        self.tx_vote_pool = tx_vote_pool
+        self.mempool = mempool
+        self.switch = switch
+        self.cfg = cfg
+        self.registry = registry
+        self._stalls: dict[str, _Stall] = {}
+        self._rr = 0  # round-robin cursor for single-peer re-offers
+
+    def tick(self, now: float | None = None) -> None:
+        if now is None:
+            now = time.monotonic()
+        inflight = self.engine.inflight_snapshot()
+        seen = set()
+        oldest = 0.0
+        for tx_hash, stake in inflight:
+            seen.add(tx_hash)
+            st = self._stalls.get(tx_hash)
+            if st is None:
+                self._stalls[tx_hash] = _Stall(now, stake)
+                continue
+            if stake > st.stake:
+                # quorum is advancing: re-arm and de-escalate
+                st.stake = stake
+                st.first = now
+                st.since = now
+                st.level = 0
+                continue
+            oldest = max(oldest, now - st.first)
+            if now - st.since >= self.cfg.stall_timeout:
+                self._reoffer(tx_hash, st.level)
+                st.level += 1
+                st.since = now  # pace the escalation ladder
+        # committed / purged txs leave the map
+        for tx_hash in list(self._stalls):
+            if tx_hash not in seen:
+                del self._stalls[tx_hash]
+        self.registry.set_watchdog_state(len(inflight), oldest)
+
+    # -- the re-offer itself --
+
+    def _reoffer(self, tx_hash: str, level: int) -> None:
+        peers = self.switch.peers()
+        if not peers:
+            return
+        if level == 0:
+            self._rr += 1
+            targets = [peers[self._rr % len(peers)]]
+        else:
+            targets = peers
+        segs = self.tx_vote_pool.segs_for_tx(tx_hash, self.cfg.max_reoffer_votes)
+        votes_sent = 0
+        if segs:
+            frame = _MSG_VOTES_B + b"".join(segs)
+            for p in targets:
+                if p.try_send(CHANNEL_TXVOTE, frame):
+                    votes_sent += len(segs)
+        txs_sent = 0
+        try:
+            tx_key = bytes.fromhex(tx_hash)
+        except ValueError:
+            tx_key = None
+        if tx_key is not None:
+            tx = self.mempool.get_tx(tx_key)
+            if tx is not None:
+                frame = encode_tx_batch([tx])
+                for p in targets:
+                    if p.try_send(CHANNEL_MEMPOOL, frame):
+                        txs_sent += 1
+        self.registry.note_watchdog_fired(
+            escalated=level > 0, votes=votes_sent, txs=txs_sent
+        )

@@ -47,6 +47,8 @@ class LocalNet:
         n_nodes: int | None = None,
         fault_plan=None,  # FaultSpec/FaultPlan/ChaosRouter: chaos p2p (faults/)
         regossip_interval: float | None = None,
+        health: bool = True,
+        health_config=None,  # HealthConfig override (health/config.py)
     ):
         """n_nodes: host only the first n_nodes validators as full nodes
         (default: one node per validator). A large validator set does not
@@ -99,37 +101,80 @@ class LocalNet:
             self.chaos = fault_plan
             if regossip_interval is None:
                 regossip_interval = 0.25
+        # rebuild inputs, kept so durable members can be crashed and
+        # revived over their on-disk artifacts (make_durable/revive_node)
+        self._cfg = cfg
+        self._app_factory = app_factory
+        self._verifier = verifier
+        self._gossip_batch = gossip_batch
+        self._use_device_verifier = use_device_verifier
+        self._mempool_broadcast = mempool_broadcast
+        self._enable_consensus = enable_consensus
+        self._sign = sign
+        self._rpc = rpc
+        self._index_txs = index_txs
+        self._ticker_factory = ticker_factory
+        self._wal_dir = wal_dir
+        self._regossip_interval = regossip_interval
+        self._health = health
+        self._health_config = health_config
+        self._durable_roots: dict[int, str] = {}
+        self._down: set[int] = set()
         hosted = priv_vals if n_nodes is None else priv_vals[:n_nodes]
-        for i, pv in enumerate(hosted):
-            node = Node(
-                node_id=f"node{i}",
-                chain_id=chain_id,
-                val_set=self.val_set,
-                app=app_factory(),
-                # a shared verifier instance (same val_set for every node)
-                # reuses one set of device epoch tables + compiled shapes
-                verifier=verifier,
-                priv_val=pv,
-                node_config=NodeConfig(
-                    config=cfg,
-                    gossip_batch=gossip_batch,
-                    use_device_verifier=use_device_verifier,
-                    mempool_broadcast=mempool_broadcast,
-                    enable_consensus=enable_consensus,
-                    # sign=False: fast-path votes are injected externally
-                    # (pregenerated-vote replay, BASELINE config 1); the
-                    # node keeps its consensus identity either way
-                    sign_votes=sign,
-                    rpc_port=0 if rpc else None,
-                    index_txs=index_txs,
-                    ticker_factory=ticker_factory,
-                    consensus_wal_path=(
-                        f"{wal_dir}/node{i}-consensus.wal" if wal_dir else ""
-                    ),
-                    regossip_interval=regossip_interval,
-                ),
-            )
-            self.nodes.append(node)
+        for i, _pv in enumerate(hosted):
+            self.nodes.append(self._build_node(i))
+
+    def _build_node(self, i: int) -> Node:
+        root = self._durable_roots.get(i)
+        dbs = {}
+        cfg = self._cfg
+        consensus_wal = (
+            f"{self._wal_dir}/node{i}-consensus.wal" if self._wal_dir else ""
+        )
+        if root is not None:
+            import copy
+
+            from ..store.db import FileDB
+
+            dbs = {
+                "tx_store_db": FileDB(f"{root}/txstore.db"),
+                "state_db": FileDB(f"{root}/state.db"),
+                "block_db": FileDB(f"{root}/blocks.db"),
+            }
+            consensus_wal = f"{root}/consensus.wal"
+            # pool WALs too (CrashDrill parity): a private config copy so
+            # the in-memory members don't start writing WALs as well
+            cfg = copy.deepcopy(cfg)
+            cfg.mempool.wal_dir = root
+        return Node(
+            node_id=f"node{i}",
+            chain_id=self.chain_id,
+            val_set=self.val_set,
+            app=self._app_factory(),
+            # a shared verifier instance (same val_set for every node)
+            # reuses one set of device epoch tables + compiled shapes
+            verifier=self._verifier,
+            priv_val=self.priv_vals[i],
+            node_config=NodeConfig(
+                config=cfg,
+                gossip_batch=self._gossip_batch,
+                use_device_verifier=self._use_device_verifier,
+                mempool_broadcast=self._mempool_broadcast,
+                enable_consensus=self._enable_consensus,
+                # sign=False: fast-path votes are injected externally
+                # (pregenerated-vote replay, BASELINE config 1); the
+                # node keeps its consensus identity either way
+                sign_votes=self._sign,
+                rpc_port=0 if self._rpc else None,
+                index_txs=self._index_txs,
+                ticker_factory=self._ticker_factory,
+                consensus_wal_path=consensus_wal,
+                regossip_interval=self._regossip_interval,
+                health=self._health,
+                health_config=self._health_config,
+            ),
+            **dbs,
+        )
 
     def start(self) -> None:
         if self.chaos is not None:
@@ -142,6 +187,76 @@ class LocalNet:
         for i in range(len(self.nodes)):
             for j in range(i + 1, len(self.nodes)):
                 connect_switches(self.nodes[i].switch, self.nodes[j].switch)
+        # health monitors can only heal links they can re-dial: give each
+        # one a reconnector so peer-score evictions become reconnect
+        # cycles instead of permanent degradation
+        for node in self.nodes:
+            if node.health is not None:
+                node.health.set_reconnector(self._make_reconnector(node))
+
+    def _make_reconnector(self, node: Node):
+        """Closure handed to node's PeerScoreBoard: re-dial a peer by
+        switch id over a fresh in-memory pipe (the LocalNet analog of the
+        reference's persistent-peer redial loop)."""
+
+        def reconnect(dst_id: str) -> bool:
+            target = None
+            for other in self.nodes:
+                if other is not node and other.switch.node_id == dst_id:
+                    target = other
+                    break
+            if target is None or not target.switch.is_running:
+                return False
+            if not node.switch.is_running:
+                return False
+            if node.switch.get_peer(dst_id) is not None:
+                return True  # raced with an inbound redial: already healed
+            # the evicting side dropped its end; the far side may still
+            # hold the dead half of the old pipe — clear it first or
+            # add_peer_conn rejects the redial as a duplicate
+            stale = target.switch.get_peer(node.switch.node_id)
+            if stale is not None:
+                target.switch.stop_peer(stale, reason="stale half-link")
+            connect_switches(node.switch, target.switch)
+            return True
+
+        return reconnect
+
+    # -- durable members: crash/revive drills (faults/crash.py analog) --
+
+    def make_durable(self, i: int, root: str) -> None:
+        """Rebuild node i (pre-start) over FileDB stores + consensus WAL
+        under ``root`` so it can be crashed and revived in place."""
+        if self.nodes[i]._started:
+            raise RuntimeError("make_durable must run before start()")
+        self._durable_roots[i] = root
+        self.nodes[i] = self._build_node(i)
+
+    def crash_node(self, i: int) -> Node:
+        """Stop node i in place (peers see the link die); state survives
+        only what its stores persisted. Returns the stopped node."""
+        node = self.nodes[i]
+        node.stop()
+        self._down.add(i)
+        return node
+
+    def revive_node(self, i: int) -> Node:
+        """Rebuild node i over its durable artifacts (fresh app instance,
+        handshake replay + catchup) and rejoin the mesh."""
+        if i not in self._down:
+            raise RuntimeError(f"node {i} is not down")
+        node = self._build_node(i)
+        self.nodes[i] = node
+        if self.chaos is not None:
+            self.chaos.install([node.switch])
+        node.start()
+        for j, other in enumerate(self.nodes):
+            if j != i and j not in self._down:
+                connect_switches(node.switch, other.switch)
+        if node.health is not None:
+            node.health.set_reconnector(self._make_reconnector(node))
+        self._down.discard(i)
+        return node
 
     def stop(self) -> None:
         for node in self.nodes:

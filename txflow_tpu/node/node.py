@@ -77,6 +77,14 @@ class NodeConfig:
     # (bounded retry -> CPU fallback -> device re-promotion) so a device
     # failure degrades throughput instead of erroring the vote path
     resilient_verifier: bool = True
+    # self-healing liveness layer (health/): quorum-stall watchdog, peer
+    # scoring + reconnect backoff, degraded-mode registry behind the RPC
+    # /health endpoint. Strictly additive to the data path (re-offers are
+    # dedup'd, eviction requires a reconnector); False drops the monitor
+    # thread entirely
+    health: bool = True
+    # HealthConfig override (None = defaults; see health/config.py)
+    health_config: object = None
 
 
 class Node:
@@ -289,6 +297,13 @@ class Node:
 
             self.grpc = GRPCBroadcastServer(self, host=nc.rpc_host, port=nc.grpc_port)
 
+        # -- self-healing liveness layer (health/monitor.py) --
+        self.health = None
+        if nc.health:
+            from ..health import HealthMonitor
+
+            self.health = HealthMonitor(self, nc.health_config)
+
         self._started = False
 
     # -- state view read by reactors (reference reads state.State) --
@@ -362,11 +377,15 @@ class Node:
             self.rpc.start()
         if self.grpc is not None:
             self.grpc.start()
+        if self.health is not None:
+            self.health.start()
 
     def stop(self) -> None:
         if not self._started:
             return
         self._started = False
+        if self.health is not None:
+            self.health.stop()
         if self.rpc is not None:
             self.rpc.stop()
         if self.grpc is not None:

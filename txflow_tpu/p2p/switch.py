@@ -16,6 +16,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import time
 
 from .base import ChannelDescriptor, Reactor
 from .transport import (
@@ -27,6 +28,38 @@ from .transport import (
 
 _HANDSHAKE_CHANNEL = 0xFF
 _WAKE_CHANNEL = 0xFE  # internal sentinel: wakes a send loop, never sent
+
+
+class PeerStats:
+    """Per-peer liveness counters read by the health layer's peer scorer
+    (health/peers.py). Plain int bumps under the GIL — the send/recv loops
+    must not pay a lock for observability; the scorer reads deltas between
+    ticks, so a torn read only smears one tick."""
+
+    __slots__ = (
+        "send_attempts",
+        "send_ok",
+        "send_fail",
+        "recv_count",
+        "duplicates",
+        "last_recv",
+        "connected_at",
+    )
+
+    def __init__(self):
+        now = time.monotonic()
+        # frames handed to send/try_send, counted BEFORE the fault-
+        # injection hook: a black-holed link (chaos partition) reports
+        # send success and never reaches the transport loop, so attempt
+        # count is the only signal that we kept talking to a silent peer
+        # (health/peers.py staleness gate)
+        self.send_attempts = 0
+        self.send_ok = 0  # frames handed to the transport successfully
+        self.send_fail = 0  # transport failures + queue-full backpressure
+        self.recv_count = 0  # frames received from the peer
+        self.duplicates = 0  # frames the owning reactor flagged as dups
+        self.last_recv = now
+        self.connected_at = now
 
 
 class Peer:
@@ -57,6 +90,7 @@ class Peer:
         # deferred, duplicated) it. Installed via Switch.set_fault_injector;
         # None (the default) costs one attribute read on the send path.
         self.intercept = None
+        self.stats = PeerStats()
 
     def set(self, key: str, value) -> None:
         self.kv[key] = value
@@ -72,6 +106,7 @@ class Peer:
         try:
             self._reliable_q.put_nowait((chan_id, msg))
         except queue.Full:
+            self.stats.send_fail += 1
             return False  # peer stalled beyond any live-round backlog
         # wake the send loop if it is blocked on the shared queue
         try:
@@ -84,6 +119,7 @@ class Peer:
         """Queue a message; blocks under backpressure. False if peer down."""
         if not self._running.is_set():
             return False
+        self.stats.send_attempts += 1
         ic = self.intercept
         if ic is not None:
             handled = ic(self, chan_id, msg)
@@ -102,11 +138,13 @@ class Peer:
             self._send_q.put((prio, next(self._seq), chan_id, msg), timeout=timeout)
             return True
         except queue.Full:
+            self.stats.send_fail += 1
             return False
 
     def try_send(self, chan_id: int, msg: bytes) -> bool:
         if not self._running.is_set():
             return False
+        self.stats.send_attempts += 1
         ic = self.intercept
         if ic is not None:
             handled = ic(self, chan_id, msg)
@@ -124,6 +162,7 @@ class Peer:
             self._send_q.put_nowait((prio, next(self._seq), chan_id, msg))
             return True
         except queue.Full:
+            self.stats.send_fail += 1
             return False
 
     def is_running(self) -> bool:
@@ -395,8 +434,10 @@ class Switch:
                 if chan_id == _WAKE_CHANNEL:
                     continue
             if not peer.conn.send(chan_id, msg):
+                peer.stats.send_fail += 1
                 self.stop_peer(peer, reason="send failed")
                 return
+            peer.stats.send_ok += 1
 
     def _recv_loop(self, peer: Peer) -> None:
         while peer._running.is_set():
@@ -407,6 +448,9 @@ class Switch:
                 return
             except TimeoutError:
                 continue
+            st = peer.stats
+            st.recv_count += 1
+            st.last_recv = time.monotonic()
             reactor = self._chan_to_reactor.get(chan_id)
             if reactor is None:
                 continue  # unknown channel: ignore (switch filters by NodeInfo upstream)

@@ -335,6 +335,20 @@ class TxVotePool(IngestLogPool):
         raw, pos = self._entries_from(cursor, limit)
         return [(k, e.vote, e.height, e.seg) for k, e in raw], pos
 
+    def segs_for_tx(self, tx_hash: str, limit: int = 512) -> list[bytes]:
+        """Wire segments of every live vote for one tx (the quorum-stall
+        watchdog's targeted re-offer input, health/watchdog.py). O(pool)
+        scan — called only for a tx already stalled past a deadline, never
+        on the gossip path."""
+        out: list[bytes] = []
+        with self._mtx:
+            for e in self._votes.values():
+                if e.vote.tx_hash == tx_hash:
+                    out.append(e.seg)
+                    if len(out) >= limit:
+                        break
+        return out
+
     def remove(self, keys: list[bytes], cache_too: bool = False) -> None:
         """Remove votes by key (quorum purge path)."""
         with self._mtx:

@@ -117,7 +117,12 @@ class MempoolReactor(Reactor):
             for tx in txs:
                 try:
                     self.mempool.check_tx(tx, TxInfo(sender_id=pid))
-                except (ErrTxInCache, ErrMempoolIsFull, ErrTxTooLarge, ValueError):
+                except ErrTxInCache:
+                    # dup delivery: feeds the peer's health score
+                    # (health/peers.py); gossip redundancy is discounted
+                    peer.stats.duplicates += 1
+                    continue
+                except (ErrMempoolIsFull, ErrTxTooLarge, ValueError):
                     continue  # app rejection / dup: log-and-ignore (:137)
         elif msg_type == MSG_HEIGHT:
             height, _ = amino.read_uvarint(msg, 1)

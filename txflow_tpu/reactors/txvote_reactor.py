@@ -195,12 +195,15 @@ class TxVoteReactor(Reactor):
                 if hit is not None:
                     vk, vote = hit
                     if pool.add_sender(vk, pid):
-                        # dup AND the pool still holds it: nothing to do.
+                        # dup AND the pool still holds it: nothing to do
+                        # beyond the peer's dup counter (health scoring —
+                        # legit gossip redundancy is discounted there).
                         # If the pool dropped it (purge/flush/eviction),
                         # fall through to the authoritative check_tx path
                         # — the wire cache must never overrule the pool's
                         # own re-accept policy (r3 review finding) — but
                         # reuse the shared decoded object either way.
+                        peer.stats.duplicates += 1
                         continue
                     if pool.in_cache(vk):
                         # pool dropped it but its dedup cache still vetoes
@@ -209,6 +212,7 @@ class TxVoteReactor(Reactor):
                         # ErrTxInCache and no side effects (the entry is
                         # gone, so there is no sender set to update) —
                         # skip the authoritative round trip entirely
+                        peer.stats.duplicates += 1
                         continue
                     ingest.append((wk, vote))
                 else:
@@ -236,6 +240,8 @@ class TxVoteReactor(Reactor):
                 for (wk, vote), err in zip(ingest, errs):
                     if err is None or isinstance(err, ErrTxInCache):
                         seen.put(wk, (vote.vote_key(), vote))
+                    if err is not None and isinstance(err, ErrTxInCache):
+                        peer.stats.duplicates += 1
         elif msg_type == MSG_HEIGHT:
             height, _ = amino.read_uvarint(msg, 1)
             peer.set(PEER_HEIGHT_KEY, height)

@@ -244,7 +244,7 @@ class RPCServer:
             "/abci_query": self._abci_query,
             "/tx_search": self._tx_search,
             "/metrics": self._metrics,
-            "/health": lambda q: {},
+            "/health": self._health,
             # rpccore.Routes parity (reference node/node.go:898-986)
             "/commit": self._commit,
             "/genesis": self._genesis,
@@ -317,7 +317,29 @@ class RPCServer:
                     else ""
                 ),
             },
+            "health": self._health_summary(),
         }
+
+    def _health_summary(self) -> dict:
+        """Degraded-mode digest for /status: verifier + watchdog counters
+        without the full per-peer detail of /health."""
+        mon = getattr(self.node, "health", None)
+        if mon is None:
+            return {"monitored": False}
+        snap = mon.snapshot()
+        return {
+            "monitored": True,
+            "healthy": snap["healthy"],
+            "watchdog_firings": snap["watchdog"]["firings"],
+            "peer_reconnects": snap["peers"]["reconnects"],
+            "verifier": snap["verifier"],
+        }
+
+    def _health(self, q: dict) -> dict:
+        """Full degraded-mode registry snapshot (health/registry.py); {}
+        when the node runs without a monitor, keeping the probe cheap."""
+        mon = getattr(self.node, "health", None)
+        return mon.snapshot() if mon is not None else {}
 
     def _tx(self, q: dict) -> dict:
         tx_hash = q["hash"].upper()

@@ -125,6 +125,34 @@ class Registry:
 GLOBAL = Registry()
 
 
+class HealthMetrics:
+    """Self-healing / degraded-mode metrics (health/ subsystem).
+
+    Counters are monotonic event totals (watchdog firings, peer churn);
+    gauges mirror current state (liveness verdict, verifier demotion
+    state, in-flight stall depth) so the Prometheus exposition and the
+    RPC ``/health`` endpoint read the same registry."""
+
+    def __init__(self, registry: "Registry | None" = None):
+        r = registry or GLOBAL
+        self.healthy = r.gauge("health", "healthy", "1 = all progress signals live")
+        self.watchdog_firings = r.counter("health", "watchdog_firings", "quorum-stall watchdog firings")
+        self.watchdog_escalations = r.counter("health", "watchdog_escalations", "stall re-offers escalated to all peers")
+        self.reoffered_votes = r.counter("health", "reoffered_votes", "votes re-offered by the watchdog")
+        self.reoffered_txs = r.counter("health", "reoffered_txs", "txs re-offered by the watchdog")
+        self.inflight_txs = r.gauge("health", "inflight_txs", "txs below quorum right now")
+        self.oldest_stall_age = r.gauge("health", "oldest_stall_seconds", "age of the oldest sub-quorum tx")
+        self.peer_evictions = r.counter("health", "peer_evictions", "peers evicted by score")
+        self.peer_reconnects = r.counter("health", "peer_reconnects", "score-driven reconnects that succeeded")
+        self.reconnect_failures = r.counter("health", "reconnect_failures", "reconnect attempts that failed")
+        self.n_peers = r.gauge("health", "n_peers", "connected peers")
+        self.verifier_demotions = r.gauge("health", "verifier_demotions", "device->fallback demotions")
+        self.verifier_repromotions = r.gauge("health", "verifier_repromotions", "fallback->device re-promotions")
+        self.verifier_device_failures = r.gauge("health", "verifier_device_failures", "device verify errors")
+        self.verifier_fallback_calls = r.gauge("health", "verifier_fallback_calls", "batches served by the CPU fallback")
+        self.verifier_device_healthy = r.gauge("health", "verifier_device_healthy", "1 = device lane serving")
+
+
 class TxFlowMetrics:
     """Fast-path metrics (reference txflowstate/metrics.go:17-45)."""
 
