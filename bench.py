@@ -176,6 +176,7 @@ def run_bench(platform: str) -> dict:
     warm_txs = min(64 if on_cpu else 1024, n_txs)
 
     shared_verifier = None
+    warm_registry = None
     if verifier_kind == "device":
         # ONE verifier for all nodes (same validator set): shared device
         # epoch tables, and a single bucket so exactly one kernel shape
@@ -205,9 +206,19 @@ def run_bench(platform: str) -> dict:
         t0 = time.time()
         # warm every shape the run can hit (verifier.warmup full=True:
         # the cached path's _verify_only miss ladder, or the no-cache
-        # fused combos) — a cold shape would compile mid-measurement
-        shared_verifier.warmup(full=True)
-        print(f"bench: kernel warm in {time.time()-t0:.1f}s", file=sys.stderr)
+        # fused combos) — a cold shape would compile mid-measurement.
+        # The registry snapshots the warm set so the result JSON can
+        # PROVE the timed phase ran compile-free (r5 postmortem: one
+        # missed shape buried the headline under ~160 s of compile).
+        from txflow_tpu.engine import ShapeWarmRegistry
+
+        warm_registry = ShapeWarmRegistry(shared_verifier)
+        warm_shapes = warm_registry.prewarm(full=True)
+        print(
+            f"bench: kernel warm in {time.time()-t0:.1f}s "
+            f"({len(warm_shapes)} shapes)",
+            file=sys.stderr,
+        )
 
         # supplementary metric: steady-state device-step throughput at the
         # bucket size (prep + kernel + packed readback, no pools/gossip/
@@ -292,6 +303,10 @@ def run_bench(platform: str) -> dict:
     # (12.7k vs 9.7k votes/s) — the fence is not the binding cost there
     cfg.engine.commit_interval = int(os.environ.get("BENCH_COMMIT_INTERVAL", "1"))
     cfg.engine.idle_flush = float(os.environ.get("BENCH_IDLE_FLUSH", cfg.engine.idle_flush))
+    # verify tickets in flight per engine (<=1 = serial reference loop)
+    cfg.engine.pipeline_depth = int(
+        os.environ.get("BENCH_PIPELINE_DEPTH", cfg.engine.pipeline_depth)
+    )
 
     # BASELINE config 5: BENCH_CONSENSUS=1 runs the block-path ticker
     # DURING the vote flood (blocks carry the fast-path commits as Vtxs).
@@ -595,6 +610,22 @@ def run_bench(platform: str) -> dict:
     if with_consensus:
         result["consensus"] = True
         result["block_height"] = max(n.block_store.height() for n in net.nodes)
+    # verify-pipeline overlap: device-busy / engine-active wall time,
+    # averaged over nodes (1.0 = verify calls back to back; low values
+    # mean host prep/routing dominates — see COMPONENTS.md for tuning)
+    pipe_stats = [n.txflow.pipeline_stats() for n in net.nodes]
+    ratios = [s["overlap_ratio"] for s in pipe_stats if s["overlap_ratio"] is not None]
+    result["pipeline_depth"] = cfg.engine.pipeline_depth
+    if ratios:
+        result["overlap_ratio"] = round(sum(ratios) / len(ratios), 4)
+    if warm_registry is not None:
+        # compile-contamination audit: warm_shapes is the prewarmed set,
+        # cold_shapes every shape that compiled DURING the timed phases
+        result["warm_shapes"] = len(warm_registry.warmed)
+        cold = warm_registry.cold_shapes()
+        result["compile_in_run"] = bool(cold)
+        if cold:
+            result["cold_shapes"] = [list(s) for s in cold]
     if shared_verifier is not None and hasattr(shared_verifier, "stop"):
         result["verifier_mux"] = True
         net.stop()

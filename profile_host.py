@@ -133,6 +133,7 @@ def main() -> None:
     ok = net.wait_all_committed(txs, timeout=600.0)
     wall = time.perf_counter() - t0
     committed = net.committed_votes_total()
+    pipe_stats = [n.txflow.pipeline_stats() for n in net.nodes]
     net.stop()
     if not ok:
         print("TIMEOUT", file=sys.stderr)
@@ -140,6 +141,19 @@ def main() -> None:
         f"host-pipeline ceiling: {committed/wall:,.0f} committed votes/s "
         f"({committed} votes, {wall:.2f}s, {n_vals} validators, {n_txs} txs)"
     )
+    # per-stage pipeline breakdown: where each engine's step time went.
+    # prep = drain + dedup + sign bytes; wait = blocked on ticket.result()
+    # (the verify call itself); route = quorum routing + commit handoff.
+    # overlap is verify-busy / engine-active wall time — raising
+    # pipeline_depth only helps while overlap < 1 and wait dominates.
+    for i, s in enumerate(pipe_stats):
+        ratio = s["overlap_ratio"]
+        print(
+            f"node {i}: steps={s['steps']} depth={s['depth']} "
+            f"prep={s['prep_s']:.3f}s wait={s['dispatch_wait_s']:.3f}s "
+            f"route={s['route_s']:.3f}s idle_gap={s['idle_gap_s']:.3f}s "
+            f"overlap={ratio if ratio is not None else 'n/a'}"
+        )
 
     if prof is not None:
         stats = pstats.Stats(prof)

@@ -10,6 +10,7 @@ bit-identical record of every commit decision.
 """
 
 from .execution import TxExecutor
+from .shapes import ShapeWarmRegistry
 from .txflow import TxFlow
 
-__all__ = ["TxExecutor", "TxFlow"]
+__all__ = ["TxExecutor", "ShapeWarmRegistry", "TxFlow"]

@@ -1,0 +1,114 @@
+"""Shape-warm registry: every kernel shape the verify pipeline can hit.
+
+The device verifier compiles one XLA program per (batch-bucket,
+slot-bucket) shape, and a cold shape compiles MID-RUN on the first batch
+that needs it — minutes on a tunneled TPU (the r5 bench postmortem: one
+in-run compile buried a 169 s throughput phase under ~160 s of compile,
+collapsing the headline from ~24k to 580 votes/s). With the pipelined
+engine the damage is worse: a compile stalls the in-flight ticket AND
+every batch queued behind it.
+
+``ShapeWarmRegistry`` closes the loop in three parts:
+
+1. ``enumerate_shapes()`` — predict the (kind, batch-bucket, slot-bucket)
+   shapes reachable from the verifier's configuration (mirrors
+   ``DeviceVoteVerifier.warmup``'s coverage: the `_verify_only` miss
+   ladder when a cache is attached, the fused bucket combos when not);
+2. ``prewarm()`` — run ``warmup(full=...)`` once and SNAPSHOT the shapes
+   the verifier actually dispatched (``DeviceVoteVerifier.shapes_used``),
+   which is the authoritative warm set;
+3. ``cold_shapes()`` / ``compile_in_run()`` — diff the shapes used since
+   the snapshot against it, so a run can assert (bench.py records
+   ``warm_shapes``/``compile_in_run`` in its JSON) that no compile
+   contaminated the timed phase instead of silently eating it.
+
+Wrapper verifiers (ResilientVoteVerifier, VerifierMux, FlakyVerifier) are
+unwrapped via their ``device``/``inner`` attributes; a scalar verifier has
+no compiled shapes and degrades every query to the empty set.
+"""
+
+from __future__ import annotations
+
+from ..verifier import DeviceVoteVerifier, bucket_size
+
+
+def _unwrap_device(verifier) -> DeviceVoteVerifier | None:
+    """Follow wrapper chains (.device / .inner) to the device verifier."""
+    seen = set()
+    v = verifier
+    while v is not None and id(v) not in seen:
+        if isinstance(v, DeviceVoteVerifier):
+            return v
+        seen.add(id(v))
+        v = getattr(v, "device", None) or getattr(v, "inner", None)
+    return None
+
+
+class ShapeWarmRegistry:
+    def __init__(self, verifier):
+        self._verifier = verifier
+        self.device = _unwrap_device(verifier)
+        self.warmed: set[tuple] = set()
+
+    def enumerate_shapes(self, n: int = 1, full: bool = True) -> list[tuple]:
+        """Predicted (kind, batch-bucket, slot-bucket) set for a warmup(n,
+        full) call — mirrors DeviceVoteVerifier.warmup's coverage."""
+        dev = self.device
+        if dev is None:
+            return []
+        shards = dev._n_shards
+        shapes: set[tuple] = set()
+        if dev.cache is not None:
+            # cached config: every device call is a _verify_only over a
+            # miss set, padded on the fine miss ladder with the floor
+            # slot bucket. warmup(n)'s first probe collapses to one miss
+            # (identical warm keys), then the ladder itself.
+            shapes.add((
+                "verify",
+                bucket_size(1, dev.miss_buckets, multiple=shards),
+                dev.buckets[0],
+            ))
+            limit = dev.max_batch if full else bucket_size(n, dev.buckets)
+            for b in dev.miss_buckets:
+                if b > limit:
+                    break
+                shapes.add((
+                    "verify",
+                    bucket_size(b, dev.miss_buckets, multiple=shards),
+                    dev.buckets[0],
+                ))
+            return sorted(shapes)
+        # fused config: warmup(n) compiles n's own combo; full=True adds
+        # (b, b) and (b, smallest) for every bucket b
+        shapes.add((
+            "fused",
+            bucket_size(n, dev.buckets, multiple=shards),
+            bucket_size(1, dev.buckets),
+        ))
+        if full:
+            smallest = dev.buckets[0]
+            for b in dev.buckets:
+                bb = bucket_size(b, dev.buckets, multiple=shards)
+                shapes.add(("fused", bb, bucket_size(b, dev.buckets)))
+                shapes.add(("fused", bb, smallest))
+        return sorted(shapes)
+
+    def prewarm(self, n: int = 1, full: bool = True) -> list[tuple]:
+        """Compile every reachable shape once (delegates to the verifier's
+        own warmup so wrapper policies apply) and snapshot the warm set."""
+        warm = getattr(self._verifier, "warmup", None)
+        if warm is not None:
+            warm(n, full=full)
+        if self.device is not None:
+            self.warmed = set(self.device.shapes_used)
+        return sorted(self.warmed)
+
+    def cold_shapes(self) -> list[tuple]:
+        """Shapes dispatched since prewarm that were NOT in the warm
+        snapshot — each one was an in-run compile."""
+        if self.device is None:
+            return []
+        return sorted(set(self.device.shapes_used) - self.warmed)
+
+    def compile_in_run(self) -> bool:
+        return bool(self.cold_shapes())

@@ -42,8 +42,38 @@ from ..types.validator import ValidatorSet
 from ..utils.cache import LRUCache, UnlockedLRUCache
 from ..utils.config import EngineConfig
 from ..utils.metrics import TxFlowMetrics
-from ..verifier import DeviceVoteVerifier, ScalarVoteVerifier
+from ..verifier import DeviceVoteVerifier, ReadyTicket, ScalarVoteVerifier
 from .execution import TxExecutor
+
+
+class _StepPrep:
+    """Host-side product of one pool drain: everything the verify call
+    and the routing pass need. In the pipelined loop this is built while
+    the PREVIOUS batch's kernel is still in flight; the dedup/prior state
+    it snapshots may therefore be one batch stale, which is safe because
+    routing re-validates every vote against vote_sets/_committed at
+    collect time and quorum is decided by the host TxVoteSet, never by
+    the device's (possibly stale-prior) maj23 output."""
+
+    __slots__ = (
+        "keys", "votes", "slots", "n_slots", "prior", "msgs", "sigs",
+        "val_idx", "dropped", "drain_seq", "verifier", "t0", "submit_t",
+    )
+
+    def __init__(self, drain_seq: int, t0: float):
+        self.keys: list[bytes] = []
+        self.votes: list[TxVote] = []
+        self.slots: list[int] = []
+        self.n_slots = 0
+        self.prior = None
+        self.msgs: list[bytes] = []
+        self.sigs: list[bytes] = []
+        self.val_idx = None
+        self.dropped = 0
+        self.drain_seq = drain_seq
+        self.verifier = None
+        self.t0 = t0
+        self.submit_t = t0
 
 
 class TxFlow:
@@ -126,6 +156,24 @@ class TxFlow:
         # committer retry and by claim_vtx (block delivers it instead).
         self._unapplied: dict[str, bytes] = {}
         self.app_hash = b""
+        # verify-pipeline accounting (engine thread only; racy reads by
+        # pipeline_stats are fine): busy is the wall-clock union of
+        # [submit, collect] windows — device (or host-verify) occupancy —
+        # while active sums the engine's own prep/wait/route segments.
+        # overlap_ratio = busy/active; the gap (active - busy) is the
+        # device idle time the pipeline exists to close.
+        self._pipe_steps = 0
+        self._pipe_prep_s = 0.0
+        self._pipe_wait_s = 0.0
+        self._pipe_route_s = 0.0
+        self._pipe_busy_s = 0.0
+        self._pipe_active_s = 0.0
+        self._pipe_last_collect = 0.0
+        # last step's (decided, requeued, dropped) — tests reconcile these
+        # against the step() return (decided + dropped; requeued votes are
+        # NOT counted: they re-enter via _retry and would double-count)
+        self.last_step_stats: dict | None = None
+        self._shape_registry = None
 
     # ---- lifecycle (reference OnStart :80-87) ----
 
@@ -134,6 +182,17 @@ class TxFlow:
             if self._running:
                 return
             self._running = True
+        if self.config.prewarm_shapes and self._shape_registry is None:
+            # compile every shape the pipeline can hit BEFORE serving: a
+            # cold compile inside the pipelined loop stalls the in-flight
+            # ticket and everything queued behind it (engine.shapes)
+            from .shapes import ShapeWarmRegistry
+
+            self._shape_registry = ShapeWarmRegistry(self.verifier)
+            try:
+                self._shape_registry.prewarm(full=True)
+            except Exception:
+                pass  # warmup failures degrade via ResilientVoteVerifier
         self.tx_vote_pool.enable_txs_available()
         if self.config.pipeline_commits:
             self._committer = threading.Thread(
@@ -158,6 +217,12 @@ class TxFlow:
         self.tx_executor.drain_events()
 
     def _run(self) -> None:
+        if self.config.pipeline_depth >= 2:
+            self._run_pipelined()
+        else:
+            self._run_serial()
+
+    def _run_serial(self) -> None:
         # Idle on the pool's per-vote sequence counter, NOT the once-per-
         # height txs_available event: when every pool vote is already in an
         # in-flight vote set (awaiting quorum) step() returns 0 while the
@@ -178,6 +243,97 @@ class TxFlow:
                 self.tx_vote_pool.wait_for_new(
                     seq_before, timeout=self.config.poll_interval
                 )
+
+    def _run_pipelined(self) -> None:
+        """Three-stage verify pipeline: host prep (stage 1) and commit
+        routing (stage 3) overlap the device verify in flight (stage 2).
+
+        Up to pipeline_depth tickets ride the verifier's submit/collect
+        split; the oldest is collected and ROUTED IN SUBMISSION ORDER, so
+        the pool's ingest-log order — the canonical order the serial path
+        routes in — is preserved and commit certificates are bit-identical
+        to the serial loop (routing re-validates each vote against
+        vote_sets/_committed at collect time; see _StepPrep on staleness).
+        On stop, every in-flight ticket is still collected and routed —
+        no orphaned tickets, no leaked cache claims, no lost votes."""
+        from collections import deque
+
+        depth = max(2, int(self.config.pipeline_depth))
+        inflight: deque[tuple[_StepPrep, object]] = deque()
+        m = self.metrics
+        try:
+            while True:
+                with self._mtx:
+                    if not self._running:
+                        return
+                seq_before = self.tx_vote_pool.seq()
+                # fill stage: prep+dispatch until the pipeline is full or
+                # the pool has nothing batchable. Batch coalescing only
+                # WAITS when nothing is in flight — with a ticket pending,
+                # the wait is free (the device is busy anyway), so a
+                # follow-up batch is dispatched only once min_batch votes
+                # have coalesced; dribbles stay in the pool for the next
+                # fill instead of burning a full step preamble + routing
+                # pass per couple of votes (the serial loop coalesces
+                # EVERY step — dispatching sub-min_batch batches here made
+                # the CPU bench 10x slower, not faster).
+                while len(inflight) < depth:
+                    if not inflight:
+                        self._form_batch()
+                    else:
+                        pending = (
+                            self.tx_vote_pool.seq()
+                            - self._drain_cursor
+                            + len(self._retry)
+                        )
+                        if pending < max(1, self.config.min_batch):
+                            break
+                    prep = self._prep_batch()
+                    if prep is None:
+                        break
+                    if not prep.votes:
+                        continue  # drop-only drain: cursor advanced, go on
+                    inflight.append((prep, self._submit_prep(prep)))
+                    m.pipeline_depth.set(len(inflight))
+                if not inflight:
+                    if self._committer is None and self._unapplied:
+                        self._apply_unapplied()
+                    if not self._retry:
+                        self.tx_vote_pool.wait_for_new(
+                            seq_before, timeout=self.config.poll_interval
+                        )
+                    continue
+                prep, ticket = inflight.popleft()
+                m.pipeline_depth.set(len(inflight))
+                result = self._collect(prep, ticket)
+                decided, requeued, all_deferred = self._route_result(prep, result)
+                self._pipe_steps += 1
+                if self._committer is None and self._unapplied:
+                    self._apply_unapplied()
+                if all_deferred:
+                    # every vote deferred to another engine's in-flight
+                    # claims: back off on the owner's (~100 ms class)
+                    # timescale — the serial step()'s identical wait.
+                    # Unconditional (even with tickets in flight): the
+                    # deferred votes sit in _retry, and re-prepping them
+                    # against claims the owner still holds just spins the
+                    # fill stage against the owner's in-flight call
+                    self.tx_vote_pool.wait_for_new(
+                        prep.drain_seq, timeout=self.config.defer_backoff
+                    )
+        finally:
+            # drain stage: stop() (or a crash) must not orphan tickets —
+            # collect and route the tail in submission order so cache
+            # claims settle and decided votes reach their vote sets
+            while inflight:
+                prep, ticket = inflight.popleft()
+                try:
+                    self._route_result(prep, self._collect(prep, ticket))
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+            m.pipeline_depth.set(0)
 
     def _form_batch(self) -> None:
         """Hold up to batch_wait for min_batch pending votes to coalesce.
@@ -214,11 +370,58 @@ class TxFlow:
     # ---- batched aggregation step ----
 
     def step(self) -> int:
-        """One verify+tally+commit round; returns votes processed."""
+        """One serial verify+tally+commit round (prep -> submit -> collect
+        -> route, no overlap); returns votes PROCESSED this step: votes
+        routed to a decision (added / rejected / late) plus votes dropped
+        at drain time. Votes the verifier deferred (in-batch repeats,
+        cross-engine claim deferrals) are NOT counted — they re-enter via
+        _retry and are counted by the step that finally decides them (the
+        old ``len(votes) + len(drop_now)`` counted those twice). The
+        decided/requeued/dropped split is published in last_step_stats;
+        decided + requeued always reconciles to the verified batch size.
+        """
+        prep = self._prep_batch()
+        if prep is None:
+            return 0
+        if not prep.votes:
+            self.last_step_stats = {
+                "decided": 0, "requeued": 0, "dropped": prep.dropped,
+                "batch": 0,
+            }
+            return prep.dropped
+        # device verify OUTSIDE the engine lock: holding _mtx across the
+        # ~100+ ms kernel+readback would serialize every consensus-path
+        # claim/reservation check behind full verify steps (r3 review).
+        # Routing re-validates against vote_sets/_committed, so concurrent
+        # claims during the call stay correct.
+        ticket = self._submit_prep(prep)
+        result = self._collect(prep, ticket)
+        decided, requeued, all_deferred = self._route_result(prep, result)
+        self._pipe_steps += 1
+        if all_deferred:
+            # every vote deferred (another engine owns the in-flight
+            # verifies — shared VerifyCache claims): the results land in
+            # the cache when the owner's verify finishes, which takes a
+            # device step / a scalar sweep (~100 ms class, not ~1 ms) —
+            # back off on that scale or this loop busy-spins the whole
+            # step preamble (drain + sign-bytes + key build) against the
+            # owner's in-flight call for nothing. A pool wait (not a
+            # sleep) against the PRE-drain seq snapshot, so votes that
+            # arrived during the verify call wake the engine immediately.
+            self.tx_vote_pool.wait_for_new(
+                prep.drain_seq, timeout=self.config.defer_backoff
+            )
+        return decided + prep.dropped
+
+    def _prep_batch(self) -> "_StepPrep | None":
+        """Stage 1: drain the pool, dedup against committed/held votes,
+        assign tx slots, gather prior stake, and build sign bytes — all
+        host work, under _mtx. Returns None when nothing was drained; a
+        prep with empty ``votes`` when everything drained was dropped."""
         t0 = time.perf_counter()
-        # seq snapshot BEFORE the drain: the defer-backoff wait below must
-        # wake for votes that arrive during the (~100 ms) verify call, not
-        # only after a post-step snapshot
+        # seq snapshot BEFORE the drain: the defer-backoff wait must wake
+        # for votes that arrive during the verify call, not only after a
+        # post-step snapshot
         drain_seq = self.tx_vote_pool.seq()
         with self._mtx:
             raw, self._drain_cursor = self.tx_vote_pool.entries_from(
@@ -228,8 +431,11 @@ class TxFlow:
             batch = self._retry + [(k, v) for k, v, _h, _s in raw]
             self._retry = []
             if not batch:
-                return 0
-            keys, votes, slots, slot_of, drop_now = [], [], [], {}, []
+                return None
+            prep = _StepPrep(drain_seq, t0)
+            keys, votes, slots = prep.keys, prep.votes, prep.slots
+            slot_of: dict[str, int] = {}
+            drop_now: list[bytes] = []
             for bi, (key, vote) in enumerate(batch):
                 if self._committed.__contains__(_hash_key(vote.tx_hash)) or (
                     vote.tx_hash not in self.vote_sets
@@ -260,38 +466,93 @@ class TxFlow:
                 slots.append(slot)
             if drop_now:
                 self.tx_vote_pool.remove(drop_now)
+            prep.dropped = len(drop_now)
             if not votes:
-                return len(drop_now)
+                return prep
 
             n_slots = len(slot_of)
             prior = np.zeros(n_slots, np.int64)
-            hashes = [None] * n_slots
             for tx_hash, s in slot_of.items():
-                hashes[s] = tx_hash
                 vs = self.vote_sets.get(tx_hash)
                 if vs is not None:
                     prior[s] = vs.stake()
+            prep.n_slots = n_slots
+            prep.prior = prior
 
             from ..types.tx_vote import sign_bytes_many
 
-            msgs = sign_bytes_many(votes, self.chain_id)
-            sigs = [v.signature or b"" for v in votes]
-            val_idx = np.array(
+            prep.msgs = sign_bytes_many(votes, self.chain_id)
+            prep.sigs = [v.signature or b"" for v in votes]
+            prep.val_idx = np.array(
                 [self._addr_to_idx.get(v.validator_address, -1) for v in votes],
                 dtype=np.int64,
             )
-            verifier = self.verifier
+            prep.verifier = self.verifier
+        dur = time.perf_counter() - t0
+        self._pipe_prep_s += dur
+        self._pipe_active_s += dur
+        self.metrics.pipeline_prep_seconds.add(dur)
+        return prep
 
-        # device verify OUTSIDE the engine lock: holding _mtx across the
-        # ~100+ ms kernel+readback would serialize every consensus-path
-        # claim/reservation check behind full verify steps (r3 review).
-        # Routing below re-validates against vote_sets/_committed, so
-        # concurrent claims during the call stay correct.
-        result = verifier.verify_and_tally(
-            msgs, sigs, val_idx, np.array(slots, np.int32), n_slots,
-            prior_stake=prior,
-        )
+    def _submit_prep(self, prep: "_StepPrep"):
+        """Stage 2 dispatch: hand the prepped batch to the verifier. With
+        a submit/collect verifier the kernel is enqueued and this returns
+        immediately; otherwise the verify runs inline and the ticket is
+        already complete (same decisions, no overlap)."""
+        t0 = time.perf_counter()
+        prep.submit_t = t0
+        sub = getattr(prep.verifier, "submit", None)
+        if sub is not None:
+            ticket = sub(
+                prep.msgs, prep.sigs, prep.val_idx,
+                np.array(prep.slots, np.int32), prep.n_slots,
+                prior_stake=prep.prior,
+            )
+        else:
+            ticket = ReadyTicket(
+                prep.verifier.verify_and_tally(
+                    prep.msgs, prep.sigs, prep.val_idx,
+                    np.array(prep.slots, np.int32), prep.n_slots,
+                    prior_stake=prep.prior,
+                )
+            )
+        dur = time.perf_counter() - t0
+        self._pipe_prep_s += dur
+        self._pipe_active_s += dur
+        self.metrics.pipeline_prep_seconds.add(dur)
+        return ticket
 
+    def _collect(self, prep: "_StepPrep", ticket):
+        """Stage 2 collect: block for the ticket's readback and account
+        the device-busy window ([submit, collect], unioned across
+        overlapping tickets) for the overlap ratio."""
+        t0 = time.perf_counter()
+        result = ticket.result()
+        t1 = time.perf_counter()
+        self._pipe_wait_s += t1 - t0
+        self._pipe_active_s += t1 - t0
+        self.metrics.pipeline_wait_seconds.add(t1 - t0)
+        # busy-union: overlapping [submit, collect] windows must not be
+        # double-counted, and in-order collection means the previous
+        # collect time is a sufficient watermark
+        start = max(prep.submit_t, self._pipe_last_collect)
+        if t1 > start:
+            self._pipe_busy_s += t1 - start
+        self._pipe_last_collect = t1
+        active, busy = self._pipe_active_s, self._pipe_busy_s
+        if active > 0:
+            self.metrics.pipeline_overlap_ratio.set(min(busy / active, 1.0))
+            self.metrics.pipeline_device_idle.set(max(active - busy, 0.0))
+        return result
+
+    def _route_result(self, prep: "_StepPrep", result) -> tuple[int, int, bool]:
+        """Stage 3: route the verified batch in submission (= pool ingest)
+        order into the authoritative vote sets, committing inline the
+        moment a set crosses 2/3. Returns (decided, requeued,
+        all_deferred); decided + requeued == len(prep.votes) always."""
+        t0 = time.perf_counter()
+        keys, votes = prep.keys, prep.votes
+        requeued = 0
         with self._mtx:
             self.metrics.batch_size.observe(len(votes))
             self.metrics.verified_votes.add(int(result.valid.sum()))
@@ -312,6 +573,7 @@ class TxFlow:
                     # in-batch (slot, validator) repeat: the cursor has
                     # passed this entry, so re-queue it for the next step
                     self._retry.append((keys[i], vote))
+                    requeued += 1
                     continue
                 if not valid_l[i]:
                     self.metrics.invalid_votes.add(1)
@@ -342,21 +604,37 @@ class TxFlow:
             if bad_keys:
                 self.tx_vote_pool.remove(bad_keys)
 
-        self.metrics.step_time.observe(time.perf_counter() - t0)
-        if sum(dropped_l) == len(votes):
-            # every vote deferred (another engine owns the in-flight
-            # verifies — shared VerifyCache claims): the results land in
-            # the cache when the owner's verify finishes, which takes a
-            # device step / a scalar sweep (~100 ms class, not ~1 ms) —
-            # back off on that scale or this loop busy-spins the whole
-            # step preamble (drain + sign-bytes + key build) against the
-            # owner's in-flight call for nothing. A pool wait (not a
-            # sleep) against the PRE-drain seq snapshot, so votes that
-            # arrived during the verify call wake the engine immediately.
-            self.tx_vote_pool.wait_for_new(
-                drain_seq, timeout=self.config.defer_backoff
-            )
-        return len(votes) + len(drop_now)
+        t1 = time.perf_counter()
+        self._pipe_route_s += t1 - t0
+        self._pipe_active_s += t1 - t0
+        self.metrics.pipeline_route_seconds.add(t1 - t0)
+        self.metrics.step_time.observe(t1 - prep.t0)
+        decided = len(votes) - requeued
+        self.last_step_stats = {
+            "decided": decided, "requeued": requeued,
+            "dropped": prep.dropped, "batch": len(votes),
+        }
+        return decided, requeued, requeued == len(votes)
+
+    def pipeline_stats(self) -> dict:
+        """Verify-pipeline observability snapshot (health registry,
+        profile_host, bench). overlap_ratio is device-busy wall time over
+        engine-active wall time: ~1.0 means the device (or host verify)
+        never waited on prep/routing; the idle gap is what raising
+        pipeline_depth / retuning min_batch+batch_wait should shrink."""
+        active = self._pipe_active_s
+        busy = min(self._pipe_busy_s, active)
+        return {
+            "depth": int(self.config.pipeline_depth),
+            "steps": self._pipe_steps,
+            "overlap_ratio": round(busy / active, 4) if active > 0 else None,
+            "device_busy_s": round(self._pipe_busy_s, 4),
+            "active_s": round(active, 4),
+            "idle_gap_s": round(max(active - busy, 0.0), 4),
+            "prep_s": round(self._pipe_prep_s, 4),
+            "dispatch_wait_s": round(self._pipe_wait_s, 4),
+            "route_s": round(self._pipe_route_s, 4),
+        }
 
     # ---- scalar parity API (reference TryAddVote :169-188) ----
 

@@ -118,6 +118,15 @@ class DegradedModeRegistry:
             "txvotepool_size": node.tx_vote_pool.size(),
             "committed_txs": int(node.metrics.committed_txs.value()),
         }
+        pipe = getattr(node.txflow, "pipeline_stats", None)
+        if pipe is not None:
+            # verify-pipeline health: a collapsing overlap ratio with a
+            # healthy device lane means the engine is host-bound, not
+            # device-bound — a different remediation than demotion
+            stats = pipe()
+            progress["pipeline"] = stats
+            if stats["overlap_ratio"] is not None:
+                self.metrics.pipeline_overlap.set(stats["overlap_ratio"])
         # the liveness verdict: degraded when the device lane is demoted,
         # a tx has been stalled past ~2 deadlines, or the node has no
         # peers while work is pending

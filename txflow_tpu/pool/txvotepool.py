@@ -61,6 +61,13 @@ class TxVotePool(IngestLogPool):
         self.config = config
         self.height = height
         self._votes: dict[bytes, _PoolVote] = self._items  # vote_key -> entry
+        # secondary index: tx_hash -> {vote_key: None} (an insertion-
+        # ordered set), so segs_for_tx is O(votes-for-tx) instead of a
+        # full O(pool) scan — the quorum-stall watchdog calls it per
+        # stalled tx, and at bench depth the scan was the whole pool.
+        # Maintained by BOTH ingest paths (check_tx's _ingest_locked and
+        # the inlined check_tx_many twin) and every removal path.
+        self._by_tx: dict[str, dict[bytes, None]] = {}
         self._votes_bytes = 0
         self.cache = UnlockedLRUCache(config.cache_size) if config.cache_size > 0 else NopCache()
         self._txs_available = threading.Event()
@@ -244,6 +251,10 @@ class TxVotePool(IngestLogPool):
                     entry.size = vote_size
                     entry.seg = seg
                     votes_d[key] = entry
+                    by_tx = self._by_tx.get(vote.tx_hash)
+                    if by_tx is None:
+                        by_tx = self._by_tx[vote.tx_hash] = {}
+                    by_tx[key] = None
                     log_append(key)
                     self._votes_bytes += vote_size
                     accepted = True
@@ -291,6 +302,10 @@ class TxVotePool(IngestLogPool):
             self.height, vote, {tx_info.sender_id}, vote_size, seg=seg
         )
         self._votes[key] = entry
+        by_tx = self._by_tx.get(vote.tx_hash)
+        if by_tx is None:
+            by_tx = self._by_tx[vote.tx_hash] = {}
+        by_tx[key] = None
         self._log_append(key)
         self._votes_bytes += vote_size
 
@@ -337,17 +352,29 @@ class TxVotePool(IngestLogPool):
 
     def segs_for_tx(self, tx_hash: str, limit: int = 512) -> list[bytes]:
         """Wire segments of every live vote for one tx (the quorum-stall
-        watchdog's targeted re-offer input, health/watchdog.py). O(pool)
-        scan — called only for a tx already stalled past a deadline, never
-        on the gossip path."""
+        watchdog's targeted re-offer input, health/watchdog.py). Walks the
+        per-tx index, so cost is O(votes for this tx) — a stalled node with
+        a deep pool no longer pays an O(pool) scan per watchdog firing."""
         out: list[bytes] = []
         with self._mtx:
-            for e in self._votes.values():
-                if e.vote.tx_hash == tx_hash:
-                    out.append(e.seg)
+            by_tx = self._by_tx.get(tx_hash)
+            if by_tx is None:
+                return out
+            for k in by_tx:
+                entry = self._votes.get(k)
+                if entry is not None:
+                    out.append(entry.seg)
                     if len(out) >= limit:
                         break
         return out
+
+    def _index_discard(self, k: bytes, entry: _PoolVote) -> None:
+        """Drop one key from the per-tx index (under self._mtx)."""
+        by_tx = self._by_tx.get(entry.vote.tx_hash)
+        if by_tx is not None:
+            by_tx.pop(k, None)
+            if not by_tx:
+                del self._by_tx[entry.vote.tx_hash]
 
     def remove(self, keys: list[bytes], cache_too: bool = False) -> None:
         """Remove votes by key (quorum purge path)."""
@@ -356,6 +383,7 @@ class TxVotePool(IngestLogPool):
                 entry = self._votes.pop(k, None)
                 if entry is not None:
                     self._votes_bytes -= entry.size
+                    self._index_discard(k, entry)
                 if cache_too:
                     self.cache.remove(k)
             self._log_compact()
@@ -373,6 +401,7 @@ class TxVotePool(IngestLogPool):
                 entry = self._votes.pop(k, None)
                 if entry is not None:
                     self._votes_bytes -= entry.size
+                    self._index_discard(k, entry)
             self._log_compact()
             if len(self._votes) > 0:
                 self._notify_txs_available()
@@ -380,6 +409,7 @@ class TxVotePool(IngestLogPool):
     def flush(self) -> None:
         with self._mtx:
             self._votes.clear()
+            self._by_tx.clear()
             self._log_base += len(self._log)
             self._log.clear()
             self._votes_bytes = 0

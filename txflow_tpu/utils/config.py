@@ -108,6 +108,19 @@ class EngineConfig:
     # completes on the device-step / scalar-sweep timescale, so re-trying
     # sooner only burns the step preamble against its in-flight work
     defer_backoff: float = 0.005
+    # verify pipeline: how many device verify calls the engine keeps in
+    # flight via the verifier's submit/collect split (verifier.VerifyTicket).
+    # At 2, batch N+1's host prep (drain + sign bytes + prepare_compact)
+    # and batch N-1's commit routing overlap batch N's kernel execution;
+    # tickets are collected in submission order, so commit certificates
+    # stay bit-identical to the serial path. <=1 = serial reference loop.
+    pipeline_depth: int = 2
+    # prewarm every kernel shape the verify pipeline can produce at
+    # start() (engine.shapes.ShapeWarmRegistry) so no cold compile lands
+    # inside the pipeline. Off by default: tests build engines constantly
+    # and the full warmup compiles the whole bucket ladder; bench/nodes
+    # that own a device verifier opt in.
+    prewarm_shapes: bool = False
     # overlap commit side-effects (TxStore persist, ABCI execute, pool
     # purge) with the next device verify call via a per-engine committer
     # thread (SURVEY §7 hard-part 5); False = reference-faithful inline

@@ -151,6 +151,7 @@ class HealthMetrics:
         self.verifier_device_failures = r.gauge("health", "verifier_device_failures", "device verify errors")
         self.verifier_fallback_calls = r.gauge("health", "verifier_fallback_calls", "batches served by the CPU fallback")
         self.verifier_device_healthy = r.gauge("health", "verifier_device_healthy", "1 = device lane serving")
+        self.pipeline_overlap = r.gauge("health", "pipeline_overlap_ratio", "engine verify-pipeline overlap (device-busy / active)")
 
 
 class TxFlowMetrics:
@@ -166,3 +167,16 @@ class TxFlowMetrics:
         self.batch_size = r.histogram("txflow", "batch_size", "device batch occupancy", buckets=(64, 256, 1024, 4096, 16384, 65536))
         self.step_time = r.histogram("txflow", "step_seconds", "aggregation step wall time")
         self.tx_processing_time = r.histogram("txflow", "tx_processing_seconds", "ApplyTx wall time")
+        # verify-pipeline observability (engine pipelined loop): depth is
+        # the tickets currently in flight; overlap_ratio is device-busy
+        # wall time over engine-active wall time (1.0 = the device never
+        # waited on host prep/routing); device_idle is the accumulated
+        # active time with NO verify call in flight — the gap the
+        # pipeline exists to close. The *_seconds counters are the
+        # per-stage breakdown profile_host.py prints.
+        self.pipeline_depth = r.gauge("txflow", "pipeline_depth", "verify tickets in flight")
+        self.pipeline_overlap_ratio = r.gauge("txflow", "pipeline_overlap_ratio", "device-busy / engine-active wall time")
+        self.pipeline_device_idle = r.gauge("txflow", "pipeline_device_idle_seconds", "engine-active seconds with no verify in flight")
+        self.pipeline_prep_seconds = r.counter("txflow", "pipeline_prep_seconds", "host batch-prep + dispatch seconds")
+        self.pipeline_wait_seconds = r.counter("txflow", "pipeline_wait_seconds", "seconds blocked collecting tickets")
+        self.pipeline_route_seconds = r.counter("txflow", "pipeline_route_seconds", "commit-routing seconds")

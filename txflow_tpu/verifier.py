@@ -227,6 +227,141 @@ class TallyResult:
     dropped: np.ndarray  # bool[B] in-batch (slot, validator) repeat: not processed
 
 
+class VerifyTicket:
+    """Handle to an in-flight verify+tally call (submit/collect split).
+
+    ``submit(...)`` dispatches the work — for the device verifier that
+    means the XLA program is launched but the ``np.asarray`` readback has
+    NOT been forced, so host code (batch prep for the next drain, commit
+    routing for the previous one) runs while the device computes.
+    ``result()`` blocks for the readback and returns the ``TallyResult``;
+    it may be called exactly once per ticket from any thread, and any
+    cache claims the call took are settled (stored or released) by the
+    time it returns or raises — a ticket never leaks claims.
+    """
+
+    def result(self) -> TallyResult:
+        raise NotImplementedError
+
+
+class ReadyTicket(VerifyTicket):
+    """Already-completed ticket: eager paths (scalar verifier, fallbacks)
+    present the same submit/collect surface with the work done inline."""
+
+    __slots__ = ("_result",)
+
+    def __init__(self, result: TallyResult):
+        self._result = result
+
+    def result(self) -> TallyResult:
+        return self._result
+
+
+class _FusedDeviceTicket(VerifyTicket):
+    """Dispatched fused kernel (no cache): readback + unpack at result()."""
+
+    __slots__ = ("_packed", "_n", "_n_slots", "_n_shards", "_b", "_b_slots",
+                 "_keep", "_done")
+
+    def __init__(self, packed, n, n_slots, n_shards, b, b_slots, keep):
+        self._packed = packed  # device array, readback not yet forced
+        self._n = n
+        self._n_slots = n_slots
+        self._n_shards = n_shards
+        self._b = b
+        self._b_slots = b_slots
+        self._keep = keep
+        self._done: TallyResult | None = None
+
+    def result(self) -> TallyResult:
+        if self._done is not None:
+            return self._done
+        packed = np.asarray(self._packed)  # the ONE blocking readback
+        self._packed = None
+        rows = packed.reshape(self._n_shards, -1)
+        bs = self._b // self._n_shards
+        valid = rows[:, :bs].reshape(-1).astype(bool)
+        stake = rows[0, bs : bs + self._b_slots]
+        maj23 = rows[0, bs + self._b_slots :].astype(bool)
+        self._done = TallyResult(
+            valid[: self._n],
+            stake[: self._n_slots].astype(np.int64),
+            maj23[: self._n_slots],
+            ~self._keep,
+        )
+        return self._done
+
+
+class _CachedDeviceTicket(VerifyTicket):
+    """Dispatched miss-set verify (cache path): the caller's claims stay
+    held (keepalive running) until result() stores or releases them."""
+
+    __slots__ = ("_cache", "_packed", "_keepalive", "_miss_idx", "_miss_keys",
+                 "_keys", "_valid", "_tx_slot", "_n_slots", "_prior",
+                 "_quorum", "_keep", "_pending", "_powers", "_val_idx",
+                 "_n_shards", "_b", "_done")
+
+    def __init__(self, cache, packed, keepalive, miss_idx, miss_keys, keys,
+                 valid, tx_slot, n_slots, prior, quorum, keep, pending,
+                 powers, val_idx, n_shards, b):
+        self._cache = cache
+        self._packed = packed
+        self._keepalive = keepalive
+        self._miss_idx = miss_idx
+        self._miss_keys = miss_keys
+        self._keys = keys
+        self._valid = valid
+        self._tx_slot = tx_slot
+        self._n_slots = n_slots
+        self._prior = prior
+        self._quorum = quorum
+        self._keep = keep
+        self._pending = pending
+        self._powers = powers
+        self._val_idx = val_idx
+        self._n_shards = n_shards
+        self._b = b
+        self._done: TallyResult | None = None
+
+    def result(self) -> TallyResult:
+        if self._done is not None:
+            return self._done
+        try:
+            packed = np.asarray(self._packed)  # blocking readback
+        except BaseException:
+            # claims must not outlive a failed readback (waiters would
+            # stall until the TTL) — hand them to the next asker
+            self._keepalive.__exit__(None, None, None)
+            self._cache.release_many(self._miss_keys)
+            raise
+        self._packed = None
+        self._keepalive.__exit__(None, None, None)
+        rows = packed.reshape(self._n_shards, -1)
+        bs = self._b // self._n_shards
+        sub_valid = rows[:, :bs].reshape(-1).astype(bool)[: len(self._miss_idx)]
+        self._cache.store_many(
+            [(self._keys[i], bool(v)) for i, v in zip(self._miss_idx, sub_valid)]
+        )
+        valid = self._valid
+        valid[self._miss_idx] = sub_valid
+        # host tally (int64 — no overflow constraint on this path)
+        stake = (
+            np.zeros(self._n_slots, dtype=np.int64)
+            if self._prior is None
+            else np.asarray(self._prior, dtype=np.int64).copy()
+        )
+        ok = valid & (self._tx_slot >= 0) & (self._tx_slot < self._n_slots)
+        np.add.at(
+            stake,
+            self._tx_slot[ok],
+            self._powers[self._val_idx[ok]].astype(np.int64),
+        )
+        self._done = TallyResult(
+            valid, stake, stake >= self._quorum, ~self._keep | self._pending
+        )
+        return self._done
+
+
 def first_occurrence_mask(tx_slot, val_idx) -> np.ndarray:
     """bool[B]: True for the first occurrence of each (tx_slot, val_idx) pair.
 
@@ -365,6 +500,28 @@ class ScalarVoteVerifier:
         q = self.val_set.quorum_power() if quorum is None else quorum
         return TallyResult(valid, stake, stake >= q, ~keep | pending)
 
+    def submit(
+        self,
+        msgs,
+        sigs,
+        val_idx,
+        tx_slot,
+        n_slots,
+        prior_stake=None,
+        quorum=None,
+    ) -> VerifyTicket:
+        """Submit/collect surface on the eager host path: the work runs
+        inline (there is no device to overlap with) and the ticket is
+        already complete. Subclass overrides of verify_and_tally are
+        honored — submit always routes through the instance's own
+        verify_and_tally."""
+        return ReadyTicket(
+            self.verify_and_tally(
+                msgs, sigs, val_idx, tx_slot, n_slots,
+                prior_stake=prior_stake, quorum=quorum,
+            )
+        )
+
 
 class DeviceVoteVerifier:
     """Batched device verify + tally behind the same interface.
@@ -427,6 +584,10 @@ class DeviceVoteVerifier:
             )
         )
         self.mesh = mesh
+        # every (kind, batch-bucket, slot-bucket) shape this verifier has
+        # dispatched — the shape-warm registry (engine.shapes) snapshots it
+        # after prewarm and diffs it after a run to detect in-run compiles
+        self.shapes_used: set[tuple] = set()
         # kick the native prep build NOW (cc -O3, seconds when stale): the
         # first lazy build would otherwise land inside the first verify
         # step, stalling the engine right as the node comes under load
@@ -512,12 +673,39 @@ class DeviceVoteVerifier:
         prior_stake: np.ndarray | None = None,
         quorum: int | None = None,
     ) -> TallyResult:
+        # the blocking call IS submit + collect: one code path, so the
+        # pipelined engine and the serial one take bit-identical decisions
+        return self.submit(
+            msgs, sigs, val_idx, tx_slot, n_slots,
+            prior_stake=prior_stake, quorum=quorum,
+        ).result()
+
+    def submit(
+        self,
+        msgs: list[bytes],
+        sigs: list[bytes],
+        val_idx: np.ndarray,
+        tx_slot: np.ndarray,
+        n_slots: int,
+        prior_stake: np.ndarray | None = None,
+        quorum: int | None = None,
+    ) -> VerifyTicket:
+        """Dispatch the verify+tally kernel WITHOUT forcing the readback.
+
+        JAX dispatch is async: ``self._fn(...)`` returns as soon as the
+        program is enqueued, and only ``np.asarray`` blocks on the device.
+        The returned ticket defers that readback to ``result()``, so the
+        caller can prep the next batch (or route the previous one) while
+        the device computes this one. On the cached path the caller's
+        claims are held (with keepalive) by the ticket and settled at
+        ``result()``; a dispatch failure here releases them before
+        raising."""
         n = len(msgs)
         val_idx = np.asarray(val_idx, dtype=np.int64)
         tx_slot = np.asarray(tx_slot, dtype=np.int32)
         keep = first_occurrence_mask(tx_slot, val_idx)
         if self.cache is not None:
-            return self._verify_and_tally_cached(
+            return self._submit_cached(
                 msgs, sigs, val_idx, tx_slot, n_slots, prior_stake, quorum,
                 keep,
             )
@@ -545,31 +733,23 @@ class DeviceVoteVerifier:
             prior[:n_slots] = np.asarray(prior_stake, dtype=np.int32)
         q = np.int32(self.val_set.quorum_power() if quorum is None else quorum)
 
-        packed = np.asarray(
-            self._fn(
-                s_nib, h_nib, vidx, r_y, r_sign, pre_ok, slot,
-                self._tables_dev, self._powers_dev, prior, q,
-            )
+        self.shapes_used.add(("fused", b, b_slots))
+        packed = self._fn(
+            s_nib, h_nib, vidx, r_y, r_sign, pre_ok, slot,
+            self._tables_dev, self._powers_dev, prior, q,
         )
-        # ONE readback, per-shard layout [valid b/n | stake S | maj S]
-        # (tally.compact_step_packed); stake/maj repeat the replicated
-        # global per shard — take shard 0's copy
-        rows = packed.reshape(self._n_shards, -1)
-        bs = b // self._n_shards
-        valid = rows[:, :bs].reshape(-1).astype(bool)
-        stake = rows[0, bs : bs + b_slots]
-        maj23 = rows[0, bs + b_slots :].astype(bool)
-        return TallyResult(
-            valid[:n],
-            stake[:n_slots].astype(np.int64),
-            maj23[:n_slots],
-            ~keep,
+        # ONE readback — deferred to ticket.result(); per-shard layout
+        # [valid b/n | stake S | maj S] (tally.compact_step_packed);
+        # stake/maj repeat the replicated global per shard — the ticket
+        # takes shard 0's copy
+        return _FusedDeviceTicket(
+            packed, n, n_slots, self._n_shards, b, b_slots, keep
         )
 
-    def _verify_and_tally_cached(
+    def _submit_cached(
         self, msgs, sigs, val_idx, tx_slot, n_slots, prior_stake, quorum,
         keep,
-    ) -> TallyResult:
+    ) -> VerifyTicket:
         """Cache-aware path: device-verify only the cache misses THIS
         caller claims, tally on the host. Decisions are bit-identical to
         the fused kernel — the tally is the same prior + segment-sum over
@@ -600,29 +780,39 @@ class DeviceVoteVerifier:
                 miss_idx.append(i)
             else:
                 valid[i] = cached[i]
+        q = self.val_set.quorum_power() if quorum is None else quorum
         if miss_idx:
             miss_keys = [keys[i] for i in miss_idx]
+            # keepalive: the device call can exceed the claim TTL by
+            # orders of magnitude (cold-shape compiles run minutes on
+            # TPU); without it, expired claims trigger N concurrent
+            # compiles of the same shape (VerifyCache.claim_keepalive).
+            # Entered HERE, exited by the ticket at result(): the claims
+            # stay owned for the whole dispatch->readback window, which
+            # the pipelined engine stretches across its next batch prep.
+            ka = self.cache.claim_keepalive(miss_keys)
+            ka.__enter__()
             try:
-                # keepalive: the device call can exceed the claim TTL by
-                # orders of magnitude (cold-shape compiles run minutes on
-                # TPU); without it, expired claims trigger N concurrent
-                # compiles of the same shape (VerifyCache.claim_keepalive)
-                with self.cache.claim_keepalive(miss_keys):
-                    sub_valid = self._verify_only(
-                        [msgs[i] for i in miss_idx],
-                        [sigs[i] for i in miss_idx],
-                        val_idx[miss_idx],
-                    )
+                packed, b = self._dispatch_verify_only(
+                    [msgs[i] for i in miss_idx],
+                    [sigs[i] for i in miss_idx],
+                    val_idx[miss_idx],
+                )
             except BaseException:
-                # claims must not outlive a failed verify (waiters would
-                # stall until the TTL) — hand them to the next asker
+                # claims must not outlive a failed dispatch (waiters
+                # would stall until the TTL) — hand them to the next asker
+                ka.__exit__(None, None, None)
                 self.cache.release_many(miss_keys)
                 raise
-            self.cache.store_many(
-                [(keys[i], bool(v)) for i, v in zip(miss_idx, sub_valid)]
+            # pending claims ride the dropped mask (set by the ticket):
+            # the engine re-offers them next step exactly like in-batch
+            # (slot, validator) repeats
+            return _CachedDeviceTicket(
+                self.cache, packed, ka, miss_idx, miss_keys, keys,
+                valid, tx_slot, n_slots, prior_stake, q, keep, pending,
+                self._powers, val_idx, self._n_shards, b,
             )
-            valid[miss_idx] = sub_valid
-        # host tally (int64 — no overflow constraint on this path)
+        # all hits/deferrals: nothing to dispatch — host tally, done now
         stake = (
             np.zeros(n_slots, dtype=np.int64)
             if prior_stake is None
@@ -632,14 +822,23 @@ class DeviceVoteVerifier:
         np.add.at(
             stake, tx_slot[ok], self._powers[val_idx[ok]].astype(np.int64)
         )
-        q = self.val_set.quorum_power() if quorum is None else quorum
-        # pending claims ride the dropped mask: the engine re-offers them
-        # next step exactly like in-batch (slot, validator) repeats
-        return TallyResult(valid, stake, stake >= q, ~keep | pending)
+        return ReadyTicket(
+            TallyResult(valid, stake, stake >= q, ~keep | pending)
+        )
 
     def _verify_only(self, msgs, sigs, val_idx) -> np.ndarray:
         """Device signature verification without the tally (slots parked
-        at -1, minimal slot bucket): bool[n]."""
+        at -1, minimal slot bucket): bool[n]. Blocking (warmup uses it);
+        the cached submit path dispatches via _dispatch_verify_only and
+        defers this readback to the ticket."""
+        packed, b = self._dispatch_verify_only(msgs, sigs, val_idx)
+        rows = np.asarray(packed).reshape(self._n_shards, -1)
+        bs = b // self._n_shards
+        return rows[:, :bs].reshape(-1).astype(bool)[: len(msgs)]
+
+    def _dispatch_verify_only(self, msgs, sigs, val_idx):
+        """Enqueue the verify-only program; returns (device_array, b)
+        without forcing the readback."""
         n = len(msgs)
         # fine-grained buckets: cached-path miss sets are far smaller than
         # engine drains (other engines own most votes via claims), and
@@ -652,24 +851,21 @@ class DeviceVoteVerifier:
         b_slots = self.buckets[0]
         batch = ed25519_batch.prepare_compact(msgs, sigs, val_idx, self.epoch)
         pad = b - n
-        packed = np.asarray(
-            self._fn(
-                _pad(batch.s_nibbles, pad),
-                _pad(batch.h_nibbles, pad),
-                _pad(batch.val_idx, pad),
-                _pad(batch.r_y, pad),
-                _pad(batch.r_sign, pad),
-                _pad(batch.pre_ok, pad),
-                np.full(b, -1, np.int32),
-                self._tables_dev,
-                self._powers_dev,
-                np.zeros(b_slots, np.int32),
-                np.int32(1),
-            )
+        self.shapes_used.add(("verify", b, b_slots))
+        packed = self._fn(
+            _pad(batch.s_nibbles, pad),
+            _pad(batch.h_nibbles, pad),
+            _pad(batch.val_idx, pad),
+            _pad(batch.r_y, pad),
+            _pad(batch.r_sign, pad),
+            _pad(batch.pre_ok, pad),
+            np.full(b, -1, np.int32),
+            self._tables_dev,
+            self._powers_dev,
+            np.zeros(b_slots, np.int32),
+            np.int32(1),
         )
-        rows = packed.reshape(self._n_shards, -1)
-        bs = b // self._n_shards
-        return rows[:, :bs].reshape(-1).astype(bool)[:n]
+        return packed, b
 
 
 class ResilientVoteVerifier:
@@ -816,6 +1012,83 @@ class ResilientVoteVerifier:
             prior_stake=prior_stake, quorum=quorum,
         )
 
+    def submit(
+        self,
+        msgs,
+        sigs,
+        val_idx,
+        tx_slot,
+        n_slots,
+        prior_stake=None,
+        quorum=None,
+    ) -> VerifyTicket:
+        """Async dispatch with the degradation policy at COLLECT time.
+
+        A healthy device gets one async dispatch attempt; a dispatch
+        error (or an error surfacing at the ticket's readback) is
+        recorded and the batch re-runs through the full blocking
+        verify_and_tally policy — bounded retry, backoff, CPU fallback,
+        probe re-promotion — so a pipelined engine degrades exactly like
+        a serial one, just one ticket later."""
+        args = (msgs, sigs, val_idx, tx_slot, n_slots, prior_stake, quorum)
+        if self._should_try_device():
+            sub = getattr(self.device, "submit", None)
+            if sub is not None:
+                try:
+                    inner = sub(
+                        msgs, sigs, val_idx, tx_slot, n_slots,
+                        prior_stake=prior_stake, quorum=quorum,
+                    )
+                except Exception as e:
+                    with self._lock:
+                        self.device_failures += 1
+                        self.last_error = e
+                    # fall through: the blocking path owns retry/fallback
+                else:
+                    return _ResilientTicket(self, inner, args)
+        return ReadyTicket(
+            self.verify_and_tally(
+                msgs, sigs, val_idx, tx_slot, n_slots,
+                prior_stake=prior_stake, quorum=quorum,
+            )
+        )
+
+
+class _ResilientTicket(VerifyTicket):
+    """Device ticket wrapped in the resilience policy: a readback failure
+    records the device error and re-serves the batch via the outer
+    verifier's blocking policy path (retry/backoff/fallback)."""
+
+    __slots__ = ("_outer", "_inner", "_args", "_done")
+
+    def __init__(self, outer: ResilientVoteVerifier, inner: VerifyTicket, args):
+        self._outer = outer
+        self._inner = inner
+        self._args = args
+        self._done: TallyResult | None = None
+
+    def result(self) -> TallyResult:
+        if self._done is not None:
+            return self._done
+        outer = self._outer
+        try:
+            res = self._inner.result()
+        except Exception as e:
+            with outer._lock:
+                outer.device_failures += 1
+                outer.last_error = e
+            msgs, sigs, val_idx, tx_slot, n_slots, prior, quorum = self._args
+            # cache claims were settled by the failed ticket (release on
+            # readback error), so the policy re-run can re-claim them
+            res = outer.verify_and_tally(
+                msgs, sigs, val_idx, tx_slot, n_slots,
+                prior_stake=prior, quorum=quorum,
+            )
+        else:
+            outer._mark_device(True)
+        self._done = res
+        return res
+
 
 def _pad(a: np.ndarray, pad: int) -> np.ndarray:
     if pad == 0:
@@ -851,6 +1124,7 @@ class VerifierMux:
         inner,
         max_batch_per_caller: int = 4096,
         gather_wait: float = 0.01,
+        pipeline_depth: int = 2,
     ):
         import queue as _q
         import threading as _t
@@ -861,9 +1135,15 @@ class VerifierMux:
         # inner.max_batch votes across callers
         self.max_batch = max_batch_per_caller
         self.gather_wait = gather_wait
+        # merged device calls kept in flight when the inner verifier has a
+        # submit/collect split: the dispatcher launches batch N+1 while the
+        # collector still awaits batch N's readback (in submission order).
+        # <=1 degrades to the serial serve loop.
+        self.pipeline_depth = max(1, pipeline_depth)
         self._q: _q.SimpleQueue = _q.SimpleQueue()
         self._running = False
         self._thread: _t.Thread | None = None
+        self._collector: _t.Thread | None = None
         self._lock = _t.Lock()
         # dispatcher generation: a dispatcher that outlives its stop() (a
         # long device batch ran past the join timeout) exits on its own at
@@ -872,6 +1152,7 @@ class VerifierMux:
         self._gen = 0
 
     def start(self) -> None:
+        import queue as _q
         import threading as _t
 
         with self._lock:
@@ -880,8 +1161,17 @@ class VerifierMux:
             self._running = True
             self._gen += 1
             gen = self._gen
+        # a FRESH in-flight queue per generation: a retired dispatcher's
+        # exit sentinel must not kill a restarted generation's collector
+        pending: _q.Queue = _q.Queue(maxsize=self.pipeline_depth)
+        self._collector = _t.Thread(
+            target=self._collect_run, args=(pending,),
+            name="verifier-mux-collect", daemon=True,
+        )
+        self._collector.start()
         self._thread = _t.Thread(
-            target=self._run, args=(gen,), name="verifier-mux", daemon=True
+            target=self._run, args=(gen, pending), name="verifier-mux",
+            daemon=True,
         )
         self._thread.start()
 
@@ -890,7 +1180,9 @@ class VerifierMux:
             self._running = False
         self._q.put(None)
         thread = self._thread
+        collector = self._collector
         self._thread = None
+        self._collector = None
         if thread is not None:
             thread.join(timeout=5)
             if thread.is_alive():
@@ -898,6 +1190,10 @@ class VerifierMux:
                 # (it fails leftovers itself on exit — see _run); draining
                 # here would steal the sentinel it needs
                 return
+        if collector is not None:
+            # the dispatcher's exit pushed the collector's sentinel; give
+            # in-flight device readbacks time to drain in order
+            collector.join(timeout=10)
         # requests still queued (behind the sentinel, or enqueued by a
         # caller that raced the _running check) would otherwise strand
         # their threads in done.wait() forever (r3 advisor low): fail them
@@ -923,6 +1219,18 @@ class VerifierMux:
     def warmup(self, n: int = 1, full: bool = False) -> None:
         self.inner.warmup(n, full=full)
 
+    def _make_req(self, msgs, sigs, val_idx, tx_slot, n_slots, prior_stake):
+        import threading as _t
+
+        return _MuxReq(
+            msgs, sigs,
+            np.asarray(val_idx, np.int64),
+            np.asarray(tx_slot, np.int64),
+            n_slots,
+            None if prior_stake is None else np.asarray(prior_stake, np.int64),
+            _t.Event(),
+        )
+
     def verify_and_tally(
         self, msgs, sigs, val_idx, tx_slot, n_slots,
         prior_stake=None, quorum=None,
@@ -933,20 +1241,41 @@ class VerifierMux:
             return self.inner.verify_and_tally(
                 msgs, sigs, val_idx, tx_slot, n_slots, prior_stake=prior_stake
             )
-        import threading as _t
-
-        req = _MuxReq(
-            msgs, sigs,
-            np.asarray(val_idx, np.int64),
-            np.asarray(tx_slot, np.int64),
-            n_slots,
-            None if prior_stake is None else np.asarray(prior_stake, np.int64),
-            _t.Event(),
-        )
+        req = self._make_req(msgs, sigs, val_idx, tx_slot, n_slots, prior_stake)
         self._q.put(req)
+        return self._await(req)
+
+    def submit(
+        self, msgs, sigs, val_idx, tx_slot, n_slots,
+        prior_stake=None, quorum=None,
+    ) -> VerifyTicket:
+        """Enqueue for merging and return immediately: the caller's engine
+        preps its next batch while the dispatcher gathers, merges, and
+        (asynchronously) runs this one. ticket.result() == the blocking
+        verify_and_tally, including the reclaim-on-stop path."""
+        if quorum is not None and quorum != self.val_set.quorum_power():
+            raise ValueError("VerifierMux cannot merge per-call quorum overrides")
+        if not self._running:  # not started: passthrough (tests, solo use)
+            sub = getattr(self.inner, "submit", None)
+            if sub is not None:
+                return sub(
+                    msgs, sigs, val_idx, tx_slot, n_slots,
+                    prior_stake=prior_stake,
+                )
+            return ReadyTicket(
+                self.inner.verify_and_tally(
+                    msgs, sigs, val_idx, tx_slot, n_slots,
+                    prior_stake=prior_stake,
+                )
+            )
+        req = self._make_req(msgs, sigs, val_idx, tx_slot, n_slots, prior_stake)
+        self._q.put(req)
+        return _MuxTicket(self, req)
+
+    def _await(self, req) -> TallyResult:
         # bounded wait + liveness re-check: if the mux stopped after the
-        # _running check above, the dispatcher may never see this request —
-        # claim it back and serve it inline on the inner verifier
+        # _running check at enqueue, the dispatcher may never see this
+        # request — claim it back and serve it inline on the inner verifier
         while not req.done.wait(timeout=1.0):
             if not self._running:
                 with self._lock:
@@ -964,7 +1293,7 @@ class VerifierMux:
             raise req.error
         return req.result
 
-    def _run(self, gen: int) -> None:
+    def _run(self, gen: int, pending) -> None:
         import queue as _q
         import time as _time
 
@@ -973,50 +1302,148 @@ class VerifierMux:
             return not self._running or self._gen != gen
 
         inner_cap = getattr(self.inner, "max_batch", 1 << 30)
-        while True:
-            if retired():
-                # we own the queue until we exit: fail anything left so no
-                # caller strands (stop() skips its own drain while we live)
-                if self._gen == gen:
-                    self._fail_queued(RuntimeError("VerifierMux stopped"))
-                return
-            req = self._q.get()
-            if req is None:
+        try:
+            while True:
                 if retired():
+                    # we own the queue until we exit: fail anything left so
+                    # no caller strands (stop() skips its drain while we live)
                     if self._gen == gen:
                         self._fail_queued(RuntimeError("VerifierMux stopped"))
                     return
-                continue
-            batch = [req]
-            total = len(req.msgs)
-            deadline = _time.monotonic() + self.gather_wait
-            while total < inner_cap:
-                remaining = deadline - _time.monotonic()
-                try:
-                    nxt = self._q.get(timeout=max(remaining, 0)) if remaining > 0 else self._q.get_nowait()
-                except _q.Empty:
-                    break
-                if nxt is None:
-                    if not self._running:
-                        self._serve(batch)
+                req = self._q.get()
+                if req is None:
+                    if retired():
                         if self._gen == gen:
                             self._fail_queued(RuntimeError("VerifierMux stopped"))
                         return
                     continue
-                if total + len(nxt.msgs) > inner_cap:
-                    self._q.put(nxt)  # next round (order among waiters is free)
-                    break
-                batch.append(nxt)
-                total += len(nxt.msgs)
-            self._serve(batch)
+                batch = [req]
+                total = len(req.msgs)
+                deadline = _time.monotonic() + self.gather_wait
+                while total < inner_cap:
+                    remaining = deadline - _time.monotonic()
+                    try:
+                        nxt = self._q.get(timeout=max(remaining, 0)) if remaining > 0 else self._q.get_nowait()
+                    except _q.Empty:
+                        break
+                    if nxt is None:
+                        if not self._running:
+                            self._serve(batch)
+                            if self._gen == gen:
+                                self._fail_queued(RuntimeError("VerifierMux stopped"))
+                            return
+                        continue
+                    if total + len(nxt.msgs) > inner_cap:
+                        self._q.put(nxt)  # next round (order among waiters is free)
+                        break
+                    batch.append(nxt)
+                    total += len(nxt.msgs)
+                self._dispatch(batch, pending)
+        finally:
+            # ALL dispatcher exits release the collector (in-flight tickets
+            # drain in submission order first — Queue is FIFO)
+            pending.put(None)
 
-    def _serve(self, batch: list) -> None:
+    def _claim(self, batch: list) -> list:
         # claim every request first: one already claimed was failed by
         # stop() or reclaimed by its caller — it is no longer ours to serve
         with self._lock:
             batch = [r for r in batch if not r.claimed]
             for r in batch:
                 r.claimed = True
+        return batch
+
+    @staticmethod
+    def _merge(batch: list):
+        """Concatenate claimed requests into one call's arguments, each
+        request's tx slots shifted into a disjoint slot range."""
+        msgs, sigs, vidx, slots, priors = [], [], [], [], []
+        off = 0
+        for r in batch:
+            msgs.extend(r.msgs)
+            sigs.extend(r.sigs)
+            vidx.append(r.val_idx)
+            slots.append(r.tx_slot + off)
+            priors.append(
+                np.zeros(r.n_slots, np.int64) if r.prior is None else r.prior
+            )
+            off += r.n_slots
+        return (
+            msgs, sigs, np.concatenate(vidx), np.concatenate(slots), off,
+            np.concatenate(priors),
+        )
+
+    @staticmethod
+    def _split(batch: list, merged: TallyResult) -> None:
+        """Hand each request its slice of the merged result."""
+        if len(batch) == 1:
+            batch[0].result = merged
+            return
+        v_off = s_off = 0
+        for r in batch:
+            nv, ns = len(r.msgs), r.n_slots
+            r.result = TallyResult(
+                merged.valid[v_off : v_off + nv],
+                merged.stake[s_off : s_off + ns],
+                merged.maj23[s_off : s_off + ns],
+                merged.dropped[v_off : v_off + nv],
+            )
+            v_off += nv
+            s_off += ns
+
+    def _dispatch(self, batch: list, pending) -> None:
+        """Claim + merge + async-submit one gathered batch; completion is
+        the collector's job. Falls back to synchronous serving when the
+        inner verifier has no submit split."""
+        sub = getattr(self.inner, "submit", None)
+        if sub is None or self.pipeline_depth <= 1:
+            self._serve(batch)
+            return
+        batch = self._claim(batch)
+        if not batch:
+            return
+        try:
+            if len(batch) == 1:
+                r = batch[0]
+                ticket = sub(
+                    r.msgs, r.sigs, r.val_idx, r.tx_slot, r.n_slots,
+                    prior_stake=r.prior,
+                )
+            else:
+                msgs, sigs, vidx, slots, off, priors = self._merge(batch)
+                ticket = sub(
+                    msgs, sigs, vidx, slots, off, prior_stake=priors
+                )
+        except Exception as e:  # dispatch failed: deliver to every waiter
+            for r in batch:
+                r.error = e
+                r.done.set()
+            return
+        # blocks while pipeline_depth batches are already in flight —
+        # backpressure instead of unbounded dispatch queueing
+        pending.put((batch, ticket))
+
+    def _collect_run(self, pending) -> None:
+        """Resolve in-flight tickets in submission order (FIFO queue) and
+        deliver each request its slice."""
+        while True:
+            item = pending.get()
+            if item is None:
+                return
+            batch, ticket = item
+            try:
+                merged = ticket.result()
+            except Exception as e:  # deliver the failure to every waiter
+                for r in batch:
+                    r.error = e
+                    r.done.set()
+                continue
+            self._split(batch, merged)
+            for r in batch:
+                r.done.set()
+
+    def _serve(self, batch: list) -> None:
+        batch = self._claim(batch)
         if not batch:
             return
         try:
@@ -1027,41 +1454,34 @@ class VerifierMux:
                     prior_stake=r.prior,
                 )
             else:
-                msgs, sigs, vidx, slots, priors = [], [], [], [], []
-                off = 0
-                for r in batch:
-                    msgs.extend(r.msgs)
-                    sigs.extend(r.sigs)
-                    vidx.append(r.val_idx)
-                    slots.append(r.tx_slot + off)
-                    priors.append(
-                        np.zeros(r.n_slots, np.int64) if r.prior is None else r.prior
-                    )
-                    off += r.n_slots
+                msgs, sigs, vidx, slots, off, priors = self._merge(batch)
                 merged = self.inner.verify_and_tally(
-                    msgs, sigs,
-                    np.concatenate(vidx),
-                    np.concatenate(slots),
-                    off,
-                    prior_stake=np.concatenate(priors),
+                    msgs, sigs, vidx, slots, off, prior_stake=priors
                 )
-                v_off = s_off = 0
-                for r in batch:
-                    nv, ns = len(r.msgs), r.n_slots
-                    r.result = TallyResult(
-                        merged.valid[v_off : v_off + nv],
-                        merged.stake[s_off : s_off + ns],
-                        merged.maj23[s_off : s_off + ns],
-                        merged.dropped[v_off : v_off + nv],
-                    )
-                    v_off += nv
-                    s_off += ns
+                self._split(batch, merged)
         except Exception as e:  # deliver the failure to every waiter
             for r in batch:
                 r.error = e
         finally:
             for r in batch:
                 r.done.set()
+
+
+class _MuxTicket(VerifyTicket):
+    """Caller-side handle to an enqueued mux request. result() runs the
+    same await/reclaim protocol as the blocking verify_and_tally."""
+
+    __slots__ = ("_mux", "_req", "_done")
+
+    def __init__(self, mux: VerifierMux, req):
+        self._mux = mux
+        self._req = req
+        self._done: TallyResult | None = None
+
+    def result(self) -> TallyResult:
+        if self._done is None:
+            self._done = self._mux._await(self._req)
+        return self._done
 
 
 class _MuxReq:
