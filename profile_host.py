@@ -148,12 +148,30 @@ def main() -> None:
     # pipeline_depth only helps while overlap < 1 and wait dominates.
     for i, s in enumerate(pipe_stats):
         ratio = s["overlap_ratio"]
-        print(
+        line = (
             f"node {i}: steps={s['steps']} depth={s['depth']} "
             f"prep={s['prep_s']:.3f}s wait={s['dispatch_wait_s']:.3f}s "
             f"route={s['route_s']:.3f}s idle_gap={s['idle_gap_s']:.3f}s "
             f"overlap={ratio if ratio is not None else 'n/a'}"
         )
+        co = s.get("coalesce") or {}
+        if co.get("enabled"):
+            # shape-stable coalescing: full = zero-padding canonical
+            # buckets, linger = deadline flushes (padded but still
+            # canonical), cold = votes demoted to the CPU fallback while
+            # their shape compiled in the background
+            line += (
+                f" coalesce[full={co['full_batches']} "
+                f"linger={co['linger_flushes']} "
+                f"cold={co['cold_fallback_votes']}]"
+            )
+        ad = s.get("adaptive_depth")
+        if ad is not None:
+            line += (
+                f" adaptive[depth={ad['depth']} changes={ad['changes']} "
+                f"win_ratio={ad['last_window_ratio']}]"
+            )
+        print(line)
 
     if prof is not None:
         stats = pstats.Stats(prof)

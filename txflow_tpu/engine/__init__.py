@@ -9,8 +9,15 @@ XLA program), with the host TxVoteSets remaining the authoritative,
 bit-identical record of every commit decision.
 """
 
+from .adaptive import AdaptiveDepthController
 from .execution import TxExecutor
-from .shapes import ShapeWarmRegistry
+from .shapes import BackgroundWarmer, ShapeWarmRegistry
 from .txflow import TxFlow
 
-__all__ = ["TxExecutor", "ShapeWarmRegistry", "TxFlow"]
+__all__ = [
+    "AdaptiveDepthController",
+    "BackgroundWarmer",
+    "ShapeWarmRegistry",
+    "TxExecutor",
+    "TxFlow",
+]

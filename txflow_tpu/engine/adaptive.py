@@ -1,0 +1,95 @@
+"""Adaptive pipeline-depth controller (closes the ROADMAP static-depth item).
+
+``EngineConfig.pipeline_depth`` fixes how many verify tickets the
+pipelined loop keeps in flight. The right number is workload-dependent:
+too shallow and the device idles between collects (overlap ratio sags
+below 1), too deep and every extra ticket only adds commit latency —
+once the device is back-to-back busy, depth buys nothing (measured r5:
+depth 2 already held overlap ≈ 0.99 on the TPU bench; the knob was left
+static with a ROADMAP note).
+
+``AdaptiveDepthController`` closes that loop from the engine's own
+pipeline accounting. The engine calls ``observe()`` once per collected
+ticket with its CUMULATIVE busy/active counters (TxFlow._pipe_busy_s /
+_pipe_active_s — busy is the unioned [submit, collect] device window,
+active the engine's prep+wait+route wall time); the controller windows
+them into per-``window``-steps deltas and steers:
+
+- window overlap < ``grow_below``: the device sat idle while the engine
+  was active — one more ticket in flight can cover the gap, grow;
+- window overlap > ``shrink_above`` and depth above the floor: the
+  device is already saturated, a shallower pipeline commits earlier for
+  the same throughput — probe down; if the probe was wrong the ratio
+  sags next window and the depth grows right back;
+- ``cooldown`` windows of hold after every change damp oscillation (the
+  first post-change window still measures the OLD depth's tail).
+
+The controller is deliberately synchronous and engine-thread-owned: no
+thread, no lock — tests drive it with synthetic counter sequences.
+"""
+
+from __future__ import annotations
+
+
+class AdaptiveDepthController:
+    def __init__(
+        self,
+        depth: int = 2,
+        min_depth: int = 2,
+        max_depth: int = 8,
+        grow_below: float = 0.85,
+        shrink_above: float = 0.97,
+        window: int = 32,
+        cooldown: int = 2,
+    ):
+        self.min_depth = max(2, int(min_depth))  # < 2 would leave the pipelined loop
+        self.max_depth = max(self.min_depth, int(max_depth))
+        self.depth = min(max(int(depth), self.min_depth), self.max_depth)
+        self.grow_below = grow_below
+        self.shrink_above = shrink_above
+        self.window = max(1, int(window))
+        self.cooldown = max(0, int(cooldown))
+        self.last_ratio: float | None = None
+        self.changes = 0
+        self._last_busy = 0.0
+        self._last_active = 0.0
+        self._last_steps = 0
+        self._cool = 0
+
+    def observe(self, busy_s: float, active_s: float, steps: int) -> int:
+        """Feed the engine's cumulative counters; returns the depth the
+        fill stage should honor from now on (== self.depth)."""
+        if steps - self._last_steps < self.window:
+            return self.depth
+        d_busy = busy_s - self._last_busy
+        d_active = active_s - self._last_active
+        self._last_busy = busy_s
+        self._last_active = active_s
+        self._last_steps = steps
+        if d_active <= 0:
+            return self.depth
+        ratio = min(d_busy / d_active, 1.0)
+        self.last_ratio = ratio
+        if self._cool > 0:
+            self._cool -= 1
+            return self.depth
+        old = self.depth
+        if ratio < self.grow_below and self.depth < self.max_depth:
+            self.depth += 1
+        elif ratio > self.shrink_above and self.depth > self.min_depth:
+            self.depth -= 1
+        if self.depth != old:
+            self.changes += 1
+            self._cool = self.cooldown
+        return self.depth
+
+    def stats(self) -> dict:
+        return {
+            "depth": self.depth,
+            "min": self.min_depth,
+            "max": self.max_depth,
+            "changes": self.changes,
+            "last_window_ratio": (
+                round(self.last_ratio, 4) if self.last_ratio is not None else None
+            ),
+        }

@@ -76,6 +76,94 @@ class _StepPrep:
         self.submit_t = t0
 
 
+class _BatchCoalescer:
+    """Shape-stable batch sizing: dispatch full canonical buckets, hold
+    partials until a linger deadline.
+
+    The device compiles one XLA program per batch-bucket shape; a batch
+    of arbitrary gossip-delivered size pads up to its bucket, wasting the
+    pad fraction of every kernel call — and a size past the prewarmed
+    ladder compiles mid-run. This coalescer makes the engine emit ONLY
+    sizes from the verifier's own bucket ladder (>= min_batch, <= the
+    drain cap): when the pending backlog covers a bucket, exactly that
+    bucket is drained (zero padding, guaranteed-warm shape, remainder
+    carries to the next decision); otherwise the partial backlog lingers
+    until either ``linger`` elapses from its first vote or the pool goes
+    idle (note_idle, the idle_flush analog), then flushes at whatever
+    size coalesced — still padded to a canonical bucket by the verifier.
+
+    decide() is called from the engine thread only; the counters feed
+    txflow_coalesce_* metrics and the bench JSON."""
+
+    __slots__ = (
+        "targets", "linger", "full_batches", "linger_flushes",
+        "_deadline", "_idle", "_clock", "_metrics",
+    )
+
+    def __init__(self, buckets, cap: int, min_batch: int, linger: float,
+                 metrics=None, clock=time.monotonic):
+        targets = sorted(b for b in buckets if min_batch <= b <= cap)
+        # no bucket fits the [min_batch, cap] band: degrade to cap-sized
+        # dispatches (still one stable shape — cap is the largest bucket)
+        self.targets = targets or [cap]
+        self.linger = linger
+        self.full_batches = 0
+        self.linger_flushes = 0
+        self._deadline: float | None = None
+        self._idle = False
+        self._clock = clock
+        self._metrics = metrics
+
+    def decide(self, pending: int) -> int:
+        """Votes to dispatch NOW: a full canonical bucket, the whole
+        backlog on linger/idle expiry, or 0 (keep coalescing)."""
+        if pending <= 0:
+            self._deadline = None
+            self._idle = False
+            return 0
+        full = 0
+        for b in self.targets:
+            if pending >= b:
+                full = b
+            else:
+                break
+        if full:
+            self._deadline = None
+            self._idle = False
+            self.full_batches += 1
+            if self._metrics is not None:
+                self._metrics.coalesce_full_batches.add(1)
+            return full
+        now = self._clock()
+        if self._deadline is None:
+            self._deadline = now + self.linger
+        if now >= self._deadline or self._idle:
+            self._deadline = None
+            self._idle = False
+            self.linger_flushes += 1
+            if self._metrics is not None:
+                self._metrics.coalesce_linger_flushes.add(1)
+            return pending
+        return 0
+
+    def note_idle(self) -> None:
+        """The pool wait timed out with votes pending and nothing new
+        arriving: flush on the next decide instead of riding out the
+        full linger (light-load latency, the idle_flush rationale)."""
+        if self._deadline is not None:
+            self._idle = True
+
+    def wait_budget(self, poll: float, idle_flush: float) -> float:
+        """Bound for the engine's pool wait so a linger flush fires on
+        time and idle detection happens on the idle_flush scale."""
+        budget = poll
+        if self._deadline is not None:
+            budget = min(budget, max(self._deadline - self._clock(), 0.0005))
+            if idle_flush > 0:
+                budget = min(budget, idle_flush)
+        return budget
+
+
 class TxFlow:
     def __init__(
         self,
@@ -174,6 +262,18 @@ class TxFlow:
         # NOT counted: they re-enter via _retry and would double-count)
         self.last_step_stats: dict | None = None
         self._shape_registry = None
+        # shape-stability layer (built in start(); None = feature off):
+        # the coalescer sizes drains to canonical buckets, the warm gate
+        # (a ShapeWarmRegistry) + cold fallback route still-cold shapes
+        # through the scalar path while the BackgroundWarmer compiles
+        # them, and the depth controller adapts the pipelined loop's
+        # in-flight budget from the live overlap ratio
+        self._coalescer: _BatchCoalescer | None = None
+        self._warm_gate = None
+        self._cold_fallback = None
+        self._warmer = None
+        self._depth_ctrl = None
+        self._cold_fallback_votes = 0
 
     # ---- lifecycle (reference OnStart :80-87) ----
 
@@ -182,6 +282,24 @@ class TxFlow:
             if self._running:
                 return
             self._running = True
+        if self.config.compilation_cache_dir:
+            # persistent XLA compilation cache: every shape this engine
+            # (or its BackgroundWarmer) compiles is banked on disk, so
+            # the next process loads instead of compiling. Must land
+            # before the first dispatch; harmless without jax.
+            import os as _os
+
+            _os.environ.setdefault(
+                "JAX_COMPILATION_CACHE_DIR", self.config.compilation_cache_dir
+            )
+            try:
+                import jax as _jax
+
+                _jax.config.update(
+                    "jax_compilation_cache_dir", self.config.compilation_cache_dir
+                )
+            except Exception:
+                pass
         if self.config.prewarm_shapes and self._shape_registry is None:
             # compile every shape the pipeline can hit BEFORE serving: a
             # cold compile inside the pipelined loop stalls the in-flight
@@ -193,6 +311,27 @@ class TxFlow:
                 self._shape_registry.prewarm(full=True)
             except Exception:
                 pass  # warmup failures degrade via ResilientVoteVerifier
+        if self.config.background_warmup and self._warm_gate is None:
+            self._setup_background_warmup()
+        if self.config.coalesce and self._coalescer is None:
+            buckets = self._verifier_buckets()
+            if buckets:
+                self._coalescer = _BatchCoalescer(
+                    buckets,
+                    cap=self._drain_cap,
+                    min_batch=self.config.min_batch,
+                    linger=self.config.coalesce_linger,
+                    metrics=self.metrics,
+                )
+        if self.config.adaptive_depth and self._depth_ctrl is None:
+            from .adaptive import AdaptiveDepthController
+
+            self._depth_ctrl = AdaptiveDepthController(
+                depth=max(2, int(self.config.pipeline_depth)),
+                min_depth=self.config.pipeline_depth_min,
+                max_depth=self.config.pipeline_depth_max,
+            )
+            self.metrics.pipeline_depth_target.set(self._depth_ctrl.depth)
         self.tx_vote_pool.enable_txs_available()
         if self.config.pipeline_commits:
             self._committer = threading.Thread(
@@ -202,9 +341,52 @@ class TxFlow:
         self._thread = threading.Thread(target=self._run, name="txflow", daemon=True)
         self._thread.start()
 
+    def _verifier_buckets(self):
+        """Canonical bucket ladder for coalescing: the verifier's own
+        buckets attribute when present (duck-typed — tests attach one to
+        a scalar verifier), else the wrapped device verifier's."""
+        buckets = getattr(self.verifier, "buckets", None)
+        if buckets:
+            return buckets
+        from .shapes import _unwrap_device
+
+        dev = _unwrap_device(self.verifier)
+        return dev.buckets if dev is not None else None
+
+    def _setup_background_warmup(self) -> None:
+        """Wire the cold-shape gate: a shared ShapeWarmRegistry as the
+        warmth oracle, a scalar fallback (sharing the device's
+        VerifyCache so verdicts memoize across the promotion boundary)
+        for batches whose shape is still cold, and the BackgroundWarmer
+        thread that compiles the enumeration concurrently with serving.
+        No-op for scalar verifiers — nothing compiles there."""
+        from .shapes import BackgroundWarmer, ShapeWarmRegistry
+
+        registry = self._shape_registry
+        if registry is None:
+            registry = ShapeWarmRegistry(self.verifier)
+            self._shape_registry = registry
+        if registry.device is None:
+            return
+        self._warm_gate = registry
+        self._cold_fallback = ScalarVoteVerifier(
+            self.val_set, shared_cache=registry.device.cache
+        )
+        self._warmer = BackgroundWarmer(registry, full=True)
+        self._warmer.start()
+
+    def _target_depth(self) -> int:
+        ctrl = self._depth_ctrl
+        if ctrl is not None:
+            return ctrl.depth
+        return max(2, int(self.config.pipeline_depth))
+
     def stop(self) -> None:
         with self._mtx:
             self._running = False
+        if self._warmer is not None:
+            self._warmer.stop()
+            self._warmer = None
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
@@ -229,20 +411,34 @@ class TxFlow:
         # event stays set, which would spin this loop at 100% CPU. The seq
         # is sampled before step() so a vote arriving mid-step wakes us
         # immediately instead of being missed for a poll interval.
+        co = self._coalescer
         while True:
             with self._mtx:
                 if not self._running:
                     return
             seq_before = self.tx_vote_pool.seq()
-            self._form_batch()
-            processed = self.step()
+            if co is not None:
+                # shape-stable sizing replaces min_batch/_form_batch: the
+                # coalescer hands out full canonical buckets (or a linger
+                # flush), and 0 means keep accumulating
+                pending = (
+                    self.tx_vote_pool.seq() - self._drain_cursor + len(self._retry)
+                )
+                limit = co.decide(pending)
+                processed = self.step(limit=limit) if limit > 0 else 0
+            else:
+                self._form_batch()
+                processed = self.step()
             if self._committer is None and self._unapplied:
                 # no committer thread to run the deferred-apply retry
                 self._apply_unapplied()
-            if processed == 0 and not self._retry:
-                self.tx_vote_pool.wait_for_new(
-                    seq_before, timeout=self.config.poll_interval
-                )
+            if processed == 0 and (co is not None or not self._retry):
+                budget = self.config.poll_interval
+                if co is not None:
+                    budget = co.wait_budget(budget, self.config.idle_flush)
+                got = self.tx_vote_pool.wait_for_new(seq_before, timeout=budget)
+                if co is not None and got == seq_before:
+                    co.note_idle()
 
     def _run_pipelined(self) -> None:
         """Three-stage verify pipeline: host prep (stage 1) and commit
@@ -258,14 +454,16 @@ class TxFlow:
         no orphaned tickets, no leaked cache claims, no lost votes."""
         from collections import deque
 
-        depth = max(2, int(self.config.pipeline_depth))
         inflight: deque[tuple[_StepPrep, object]] = deque()
         m = self.metrics
+        co = self._coalescer
+        ctrl = self._depth_ctrl
         try:
             while True:
                 with self._mtx:
                     if not self._running:
                         return
+                depth = self._target_depth()
                 seq_before = self.tx_vote_pool.seq()
                 # fill stage: prep+dispatch until the pipeline is full or
                 # the pool has nothing batchable. Batch coalescing only
@@ -276,19 +474,32 @@ class TxFlow:
                 # fill instead of burning a full step preamble + routing
                 # pass per couple of votes (the serial loop coalesces
                 # EVERY step — dispatching sub-min_batch batches here made
-                # the CPU bench 10x slower, not faster).
+                # the CPU bench 10x slower, not faster). With a coalescer,
+                # the bucket ladder replaces min_batch/_form_batch: only
+                # full canonical buckets (or linger flushes) dispatch.
                 while len(inflight) < depth:
-                    if not inflight:
-                        self._form_batch()
-                    else:
+                    if co is not None:
                         pending = (
                             self.tx_vote_pool.seq()
                             - self._drain_cursor
                             + len(self._retry)
                         )
-                        if pending < max(1, self.config.min_batch):
+                        limit = co.decide(pending)
+                        if limit <= 0:
                             break
-                    prep = self._prep_batch()
+                        prep = self._prep_batch(limit=limit)
+                    else:
+                        if not inflight:
+                            self._form_batch()
+                        else:
+                            pending = (
+                                self.tx_vote_pool.seq()
+                                - self._drain_cursor
+                                + len(self._retry)
+                            )
+                            if pending < max(1, self.config.min_batch):
+                                break
+                        prep = self._prep_batch()
                     if prep is None:
                         break
                     if not prep.votes:
@@ -298,7 +509,16 @@ class TxFlow:
                 if not inflight:
                     if self._committer is None and self._unapplied:
                         self._apply_unapplied()
-                    if not self._retry:
+                    if co is not None:
+                        budget = co.wait_budget(
+                            self.config.poll_interval, self.config.idle_flush
+                        )
+                        got = self.tx_vote_pool.wait_for_new(
+                            seq_before, timeout=budget
+                        )
+                        if got == seq_before:
+                            co.note_idle()
+                    elif not self._retry:
                         self.tx_vote_pool.wait_for_new(
                             seq_before, timeout=self.config.poll_interval
                         )
@@ -308,6 +528,13 @@ class TxFlow:
                 result = self._collect(prep, ticket)
                 decided, requeued, all_deferred = self._route_result(prep, result)
                 self._pipe_steps += 1
+                if ctrl is not None:
+                    new_depth = ctrl.observe(
+                        self._pipe_busy_s, self._pipe_active_s, self._pipe_steps
+                    )
+                    if new_depth != depth:
+                        m.pipeline_depth_target.set(new_depth)
+                        m.pipeline_depth_changes.add(1)
                 if self._committer is None and self._unapplied:
                     self._apply_unapplied()
                 if all_deferred:
@@ -369,7 +596,7 @@ class TxFlow:
 
     # ---- batched aggregation step ----
 
-    def step(self) -> int:
+    def step(self, limit: int | None = None) -> int:
         """One serial verify+tally+commit round (prep -> submit -> collect
         -> route, no overlap); returns votes PROCESSED this step: votes
         routed to a decision (added / rejected / late) plus votes dropped
@@ -379,8 +606,10 @@ class TxFlow:
         old ``len(votes) + len(drop_now)`` counted those twice). The
         decided/requeued/dropped split is published in last_step_stats;
         decided + requeued always reconciles to the verified batch size.
+        ``limit`` caps the batch (retries + fresh drain) below the drain
+        cap — the coalescer passes a canonical bucket size here.
         """
-        prep = self._prep_batch()
+        prep = self._prep_batch(limit=limit)
         if prep is None:
             return 0
         if not prep.votes:
@@ -413,12 +642,16 @@ class TxFlow:
             )
         return decided + prep.dropped
 
-    def _prep_batch(self) -> "_StepPrep | None":
+    def _prep_batch(self, limit: int | None = None) -> "_StepPrep | None":
         """Stage 1: drain the pool, dedup against committed/held votes,
         assign tx slots, gather prior stake, and build sign bytes — all
         host work, under _mtx. Returns None when nothing was drained; a
-        prep with empty ``votes`` when everything drained was dropped."""
+        prep with empty ``votes`` when everything drained was dropped.
+        ``limit`` is the total batch target (retries included) — the
+        coalescer passes a canonical bucket size so the dispatched batch
+        lands exactly on a prewarmed shape."""
         t0 = time.perf_counter()
+        target = self._drain_cap if limit is None else min(limit, self._drain_cap)
         # seq snapshot BEFORE the drain: the defer-backoff wait must wake
         # for votes that arrive during the verify call, not only after a
         # post-step snapshot
@@ -426,7 +659,7 @@ class TxFlow:
         with self._mtx:
             raw, self._drain_cursor = self.tx_vote_pool.entries_from(
                 self._drain_cursor,
-                limit=max(self._drain_cap - len(self._retry), 0),
+                limit=max(target - len(self._retry), 0),
             )
             batch = self._retry + [(k, v) for k, v, _h, _s in raw]
             self._retry = []
@@ -498,9 +731,27 @@ class TxFlow:
         """Stage 2 dispatch: hand the prepped batch to the verifier. With
         a submit/collect verifier the kernel is enqueued and this returns
         immediately; otherwise the verify runs inline and the ticket is
-        already complete (same decisions, no overlap)."""
+        already complete (same decisions, no overlap).
+
+        Cold-shape gate (background warmup): when the batch's device
+        shape has not compiled yet, the batch is demoted to the scalar
+        fallback — the SAME verdicts (the fallback shares the device's
+        VerifyCache), just on the host — instead of stalling the whole
+        pipeline behind a synchronous compile. The BackgroundWarmer
+        flips the gate shape by shape; once warm, batches promote to the
+        device and never come back."""
         t0 = time.perf_counter()
         prep.submit_t = t0
+        gate = self._warm_gate
+        if (
+            gate is not None
+            and self._cold_fallback is not None
+            and prep.verifier is self.verifier
+            and not gate.is_batch_warm(len(prep.votes), prep.n_slots)
+        ):
+            prep.verifier = self._cold_fallback
+            self._cold_fallback_votes += len(prep.votes)
+            self.metrics.warmup_cold_fallback_votes.add(len(prep.votes))
         sub = getattr(prep.verifier, "submit", None)
         if sub is not None:
             ticket = sub(
@@ -624,8 +875,11 @@ class TxFlow:
         pipeline_depth / retuning min_batch+batch_wait should shrink."""
         active = self._pipe_active_s
         busy = min(self._pipe_busy_s, active)
-        return {
-            "depth": int(self.config.pipeline_depth),
+        ctrl = self._depth_ctrl
+        stats = {
+            "depth": (
+                ctrl.depth if ctrl is not None else int(self.config.pipeline_depth)
+            ),
             "steps": self._pipe_steps,
             "overlap_ratio": round(busy / active, 4) if active > 0 else None,
             "device_busy_s": round(self._pipe_busy_s, 4),
@@ -635,6 +889,25 @@ class TxFlow:
             "dispatch_wait_s": round(self._pipe_wait_s, 4),
             "route_s": round(self._pipe_route_s, 4),
         }
+        co = self._coalescer
+        stats["coalesce"] = {
+            "enabled": co is not None,
+            "full_batches": co.full_batches if co is not None else 0,
+            "linger_flushes": co.linger_flushes if co is not None else 0,
+            "cold_fallback_votes": self._cold_fallback_votes,
+        }
+        gate = self._warm_gate
+        if gate is not None:
+            warm = len(gate.warmed)
+            stats["warmup"] = {
+                "warm_shapes": warm,
+                "total_shapes": len(gate.enumerate_shapes(full=True)),
+                "done": self._warmer.done() if self._warmer is not None else None,
+            }
+            self.metrics.warmup_warm_shapes.set(warm)
+        if ctrl is not None:
+            stats["adaptive_depth"] = ctrl.stats()
+        return stats
 
     # ---- scalar parity API (reference TryAddVote :169-188) ----
 
@@ -1033,6 +1306,18 @@ class TxFlow:
                 self.val_set = val_set
                 self._addr_to_idx = {v.address: i for i, v in enumerate(val_set)}
                 self.verifier = verifier
+                # the shape-stability layer tracks the OLD verifier's
+                # device: rebuild gate/fallback/warmer against the new
+                # epoch (new epoch tables, same bucket ladder — banked
+                # compiles still hit the persistent cache)
+                if self._warm_gate is not None:
+                    if self._warmer is not None:
+                        self._warmer.stop(timeout=0.0)
+                        self._warmer = None
+                    self._shape_registry = None
+                    self._warm_gate = None
+                    self._cold_fallback = None
+                    self._setup_background_warmup()
 
 
 def _hash_key(tx_hash: str) -> bytes:

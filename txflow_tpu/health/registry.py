@@ -127,6 +127,15 @@ class DegradedModeRegistry:
             progress["pipeline"] = stats
             if stats["overlap_ratio"] is not None:
                 self.metrics.pipeline_overlap.set(stats["overlap_ratio"])
+            self.metrics.pipeline_depth_now.set(stats.get("depth") or 0)
+            # shape-lifecycle health: sustained cold-fallback growth means
+            # the warmer is behind (or wedged) and the node is serving on
+            # the slow path — visible here before throughput graphs sag
+            coalesce = stats.get("coalesce")
+            if coalesce is not None:
+                self.metrics.warmup_cold_votes.set(
+                    coalesce.get("cold_fallback_votes", 0)
+                )
         # the liveness verdict: degraded when the device lane is demoted,
         # a tx has been stalled past ~2 deadlines, or the node has no
         # peers while work is pending

@@ -115,12 +115,43 @@ class EngineConfig:
     # tickets are collected in submission order, so commit certificates
     # stay bit-identical to the serial path. <=1 = serial reference loop.
     pipeline_depth: int = 2
+    # adaptive pipeline depth: let an AdaptiveDepthController grow/shrink
+    # the pipelined loop's in-flight ticket budget between
+    # [pipeline_depth_min, pipeline_depth_max] from the live overlap
+    # ratio (engine.adaptive; closes the ROADMAP static-depth item).
+    # pipeline_depth above stays the starting point. Off by default:
+    # deterministic depth is what the banked bench baselines were tuned
+    # at, and the controller needs windows of steps to say anything.
+    adaptive_depth: bool = False
+    pipeline_depth_min: int = 2
+    pipeline_depth_max: int = 8
+    # shape-stable batch coalescing (engine.txflow._BatchCoalescer): when
+    # the verifier exposes canonical buckets, dispatch only full-bucket
+    # batches (zero padding waste, always-prewarmed shapes) and hold
+    # partial ones until coalesce_linger elapses from the first held
+    # vote, then flush whatever coalesced (padded to its bucket — still
+    # a canonical shape). Scalar verifiers have no buckets and keep the
+    # min_batch/batch_wait forming logic unchanged.
+    coalesce: bool = True
+    coalesce_linger: float = 0.004
     # prewarm every kernel shape the verify pipeline can produce at
     # start() (engine.shapes.ShapeWarmRegistry) so no cold compile lands
     # inside the pipeline. Off by default: tests build engines constantly
     # and the full warmup compiles the whole bucket ladder; bench/nodes
     # that own a device verifier opt in.
     prewarm_shapes: bool = False
+    # background warmup (engine.shapes.BackgroundWarmer): serve from
+    # start() with ZERO blocking compile — a side thread walks the shape
+    # enumeration compiling cold shapes while batches whose shape is
+    # still cold route through the scalar/CPU fallback, then promote to
+    # the device the moment their shape lands. The streaming alternative
+    # to prewarm_shapes' stop-the-world warmup.
+    background_warmup: bool = False
+    # persistent XLA compilation cache directory (JAX_COMPILATION_CACHE_DIR):
+    # every compiled shape is banked on disk, so reruns — and background
+    # warmup walks — load instead of compile. Empty = leave the process
+    # environment alone.
+    compilation_cache_dir: str = ""
     # overlap commit side-effects (TxStore persist, ABCI execute, pool
     # purge) with the next device verify call via a per-engine committer
     # thread (SURVEY §7 hard-part 5); False = reference-faithful inline

@@ -152,6 +152,8 @@ class HealthMetrics:
         self.verifier_fallback_calls = r.gauge("health", "verifier_fallback_calls", "batches served by the CPU fallback")
         self.verifier_device_healthy = r.gauge("health", "verifier_device_healthy", "1 = device lane serving")
         self.pipeline_overlap = r.gauge("health", "pipeline_overlap_ratio", "engine verify-pipeline overlap (device-busy / active)")
+        self.warmup_cold_votes = r.gauge("health", "warmup_cold_fallback_votes", "votes served by the CPU fallback awaiting shape promotion")
+        self.pipeline_depth_now = r.gauge("health", "pipeline_depth", "engine's current (possibly adaptive) pipeline depth")
 
 
 class TxFlowMetrics:
@@ -180,3 +182,16 @@ class TxFlowMetrics:
         self.pipeline_prep_seconds = r.counter("txflow", "pipeline_prep_seconds", "host batch-prep + dispatch seconds")
         self.pipeline_wait_seconds = r.counter("txflow", "pipeline_wait_seconds", "seconds blocked collecting tickets")
         self.pipeline_route_seconds = r.counter("txflow", "pipeline_route_seconds", "commit-routing seconds")
+        # shape-stable batch coalescing (engine.txflow._BatchCoalescer):
+        # full_batches dispatched at exactly a canonical bucket (zero
+        # padding waste), linger_flushes dispatched partial by deadline
+        self.coalesce_full_batches = r.counter("coalesce", "full_batches", "batches dispatched at a full canonical bucket")
+        self.coalesce_linger_flushes = r.counter("coalesce", "linger_flushes", "partial buckets flushed by the linger deadline")
+        # background shape warmup (engine.shapes.BackgroundWarmer): votes
+        # the engine served via the scalar fallback while their device
+        # shape was still compiling, and shapes promoted so far
+        self.warmup_cold_fallback_votes = r.counter("warmup", "cold_fallback_votes", "votes served by the CPU fallback while their shape compiled")
+        self.warmup_warm_shapes = r.gauge("warmup", "warm_shapes", "kernel shapes compiled and promoted")
+        # adaptive pipeline depth (engine.adaptive.AdaptiveDepthController)
+        self.pipeline_depth_target = r.gauge("txflow", "pipeline_depth_target", "adaptive controller's current depth target")
+        self.pipeline_depth_changes = r.counter("txflow", "pipeline_depth_changes", "adaptive depth adjustments applied")

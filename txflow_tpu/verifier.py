@@ -207,6 +207,11 @@ class _ClaimKeepalive:
 
     def _run(self) -> None:
         interval = max(self._cache.claim_ttl / 2, 0.01)
+        # first beat immediately: the claims were stamped at lookup time,
+        # possibly a while before this thread got scheduled — with a short
+        # TTL (tests, aggressive configs) waiting a full interval first
+        # leaves a window where the claims are already re-claimable
+        self._cache.heartbeat_many(self._keys)
         while not self._stop.wait(interval):
             self._cache.heartbeat_many(self._keys)
 
@@ -326,6 +331,11 @@ class _CachedDeviceTicket(VerifyTicket):
     def result(self) -> TallyResult:
         if self._done is not None:
             return self._done
+        # re-stamp the claims from the collecting thread before blocking:
+        # the keepalive thread normally covers this window, but the
+        # readback can start arbitrarily long after dispatch (pipelined
+        # engine) and a missed keepalive beat must not cost ownership
+        self._cache.heartbeat_many(self._miss_keys)
         try:
             packed = np.asarray(self._packed)  # blocking readback
         except BaseException:
@@ -797,6 +807,7 @@ class DeviceVoteVerifier:
                     [msgs[i] for i in miss_idx],
                     [sigs[i] for i in miss_idx],
                     val_idx[miss_idx],
+                    claim_keys=miss_keys,
                 )
             except BaseException:
                 # claims must not outlive a failed dispatch (waiters
@@ -836,9 +847,40 @@ class DeviceVoteVerifier:
         bs = b // self._n_shards
         return rows[:, :bs].reshape(-1).astype(bool)[: len(msgs)]
 
-    def _dispatch_verify_only(self, msgs, sigs, val_idx):
+    def predicted_shapes(self, n: int, n_slots: int = 1) -> list[tuple]:
+        """Every (kind, batch-bucket, slot-bucket) shape an n-vote /
+        n_slots-tx batch can dispatch through this verifier — the
+        cold-shape gate's input (engine.shapes.ShapeWarmRegistry
+        .is_batch_warm). Cached config: the claimed miss subset has any
+        size m <= n, so the whole miss ladder up to n's rung is
+        reachable. Fused config: exactly one combo."""
+        shards = self._n_shards
+        if self.cache is not None:
+            top = bucket_size(max(n, 1), self.miss_buckets, multiple=shards)
+            shapes = []
+            for b in self.miss_buckets:
+                bb = bucket_size(b, self.miss_buckets, multiple=shards)
+                if bb > top:
+                    break
+                shapes.append(("verify", bb, self.buckets[0]))
+            return sorted(set(shapes))
+        return [(
+            "fused",
+            bucket_size(n, self.buckets, multiple=shards),
+            bucket_size(n_slots, self.buckets),
+        )]
+
+    def _dispatch_verify_only(self, msgs, sigs, val_idx, claim_keys=None):
         """Enqueue the verify-only program; returns (device_array, b)
-        without forcing the readback."""
+        without forcing the readback.
+
+        claim_keys: VerifyCache claims held for this miss set. The
+        ``self._fn`` call below is where a cold shape TRACES AND COMPILES
+        synchronously — minutes on a tunneled TPU — so the claims are
+        re-stamped from THIS thread on both sides of it, belt-and-braces
+        with the caller's keepalive thread (ADVICE r5: a stale claim
+        mid-compile hands the same keys to every co-located engine and
+        piles N concurrent compiles onto one shape)."""
         n = len(msgs)
         # fine-grained buckets: cached-path miss sets are far smaller than
         # engine drains (other engines own most votes via claims), and
@@ -852,6 +894,8 @@ class DeviceVoteVerifier:
         batch = ed25519_batch.prepare_compact(msgs, sigs, val_idx, self.epoch)
         pad = b - n
         self.shapes_used.add(("verify", b, b_slots))
+        if claim_keys and self.cache is not None:
+            self.cache.heartbeat_many(claim_keys)
         packed = self._fn(
             _pad(batch.s_nibbles, pad),
             _pad(batch.h_nibbles, pad),
@@ -865,6 +909,10 @@ class DeviceVoteVerifier:
             np.zeros(b_slots, np.int32),
             np.int32(1),
         )
+        if claim_keys and self.cache is not None:
+            # the dispatch (and any compile inside it) is behind us: stamp
+            # the claims once more so the readback window starts fresh
+            self.cache.heartbeat_many(claim_keys)
         return packed, b
 
 
