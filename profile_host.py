@@ -173,11 +173,34 @@ def main() -> None:
             )
         print(line)
 
+    _print_hygiene_summary()
+
     if prof is not None:
         stats = pstats.Stats(prof)
         stats.sort_stats("cumulative")
         stats.print_stats(40)
         stats.dump_stats("/tmp/prof.out")
+
+
+def _print_hygiene_summary() -> None:
+    """txlint digest alongside the perf numbers (same JSON as
+    ``tools/lint.py --json``): a profiling run that motivates a lock or
+    hot-path change should see the hygiene state it is about to edit."""
+    from pathlib import Path
+
+    from txflow_tpu.analysis.core import lint_tree, report_to_json
+
+    report = report_to_json(lint_tree(Path(__file__).resolve().parent))
+    n = sum(report["counts"].values())
+    s = sum(report["suppressed_counts"].values())
+    audit = os.environ.get("TXFLOW_LOCK_AUDIT") == "1"
+    print(
+        f"txlint: {report['files_scanned']} files, {n} violation(s), "
+        f"{s} suppressed; lock audit {'ON' if audit else 'off'} "
+        "(TXFLOW_LOCK_AUDIT=1 to enable)"
+    )
+    for v in report["violations"]:
+        print(f"  {v['path']}:{v['line']}: {v['rule']}: {v['message']}")
 
 
 if __name__ == "__main__":

@@ -18,6 +18,10 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Runtime lock-order auditing is ON for the whole tier-1 suite (must be
+# set before any txflow_tpu module constructs a lock). Opt out of the
+# audit by exporting TXFLOW_LOCK_AUDIT=0 explicitly.
+os.environ.setdefault("TXFLOW_LOCK_AUDIT", "1")
 
 import jax
 
@@ -34,6 +38,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running soak scenarios (tier-1 runs -m 'not slow')"
     )
+    if os.environ.get("TXFLOW_LOCK_AUDIT") == "1":
+        from txflow_tpu.analysis.lockgraph import install_probes
+
+        install_probes()
 
 
 # -- tier-1 time-budget audit -------------------------------------------
@@ -61,7 +69,42 @@ def pytest_runtest_logreport(report):
         _durations[report.nodeid] = report.duration
 
 
+def _lock_audit_gate(session):
+    """Fail the RUN (without un-passing tests) if the runtime lock-order
+    auditor observed a cycle in the acquisition graph or a lock held
+    across a declared blocking call anywhere in the suite."""
+    if os.environ.get("TXFLOW_LOCK_AUDIT") != "1":
+        return
+    from txflow_tpu.analysis.lockgraph import default_auditor
+
+    report = default_auditor().report()
+    cycles = report["cycles"]
+    blocking = report["blocking_violations"]
+    if not cycles and not blocking:
+        return
+    lines = ["runtime lock audit: violations observed during the suite:"]
+    for cyc in cycles:
+        lines.append(f"  lock-order cycle: {' -> '.join(cyc)}")
+    for bv in blocking:
+        lines.append(
+            f"  blocking call {bv['desc']!r} while holding "
+            f"{bv['held']} (thread {bv['thread']})"
+        )
+        if bv.get("stack"):
+            lines.append(f"    at: {bv['stack']}")
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+    if tr is not None:
+        tr.section("runtime lock audit", sep="=")
+        for line in lines:
+            tr.write_line(line)
+    else:
+        print("\n".join(lines))
+    if session.exitstatus == 0:
+        session.exitstatus = 1
+
+
 def pytest_sessionfinish(session, exitstatus):
+    _lock_audit_gate(session)
     offenders = sorted(
         (
             (dur, nodeid)

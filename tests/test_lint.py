@@ -1,0 +1,382 @@
+"""txlint gate + per-pass fixture tests.
+
+test_tree_is_clean is the tier-1 wiring of ``tools/lint.py --check``: the
+committed tree must carry zero unsuppressed violations (and zero parse
+errors). The fixture tests prove each pass actually FIRES on a minimal
+reproduction, so a refactor that silently lobotomizes a pass fails here
+rather than letting the tree gate rot into a no-op.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+from txflow_tpu.analysis.core import lint_source, lint_tree
+from txflow_tpu.analysis.twins import TwinPathPass, update_pins
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _src(text: str) -> str:
+    return textwrap.dedent(text)
+
+
+def _rules(violations) -> list[str]:
+    return [v.rule for v in violations]
+
+
+# ---------------------------------------------------------------------------
+# the tree gate (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_tree_is_clean():
+    report = lint_tree(REPO_ROOT)
+    assert report["errors"] == []
+    msgs = "\n".join(v.format() for v in report["violations"])
+    assert not report["violations"], f"unsuppressed txlint violations:\n{msgs}"
+    # every suppression in the tree documents itself
+    for v in report["suppressed"]:
+        assert v.justification, v.format()
+
+
+def test_cli_check_and_json():
+    out = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "lint.py"), "--check"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    out = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "lint.py"), "--json"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0
+    report = json.loads(out.stdout)
+    assert report["violations"] == []
+    assert report["files_scanned"] > 50
+    assert isinstance(report["suppressed_counts"], dict)
+
+
+# ---------------------------------------------------------------------------
+# lock-blocking
+# ---------------------------------------------------------------------------
+
+
+def test_lock_blocking_direct():
+    active, _ = lint_source(_src("""
+        class C:
+            def send(self, frame):
+                with self._mtx:
+                    self.sock.sendall(frame)
+    """), "txflow_tpu/x.py")
+    assert _rules(active) == ["lock-blocking"]
+    assert "sendall" in active[0].message
+    assert "_mtx" in active[0].message
+
+
+def test_lock_blocking_taint_through_self_call():
+    active, _ = lint_source(_src("""
+        class C:
+            def _flush(self):
+                self.wal.write(b"x")
+
+            def ingest(self, tx):
+                with self._mtx:
+                    self._flush()
+    """), "txflow_tpu/x.py")
+    assert _rules(active) == ["lock-blocking"]
+    assert "reaches blocking" in active[0].message
+
+
+def test_lock_blocking_outside_lock_is_fine():
+    active, _ = lint_source(_src("""
+        class C:
+            def send(self, frame):
+                self.sock.sendall(frame)
+                with self._mtx:
+                    self.n += 1
+    """), "txflow_tpu/x.py")
+    assert active == []
+
+
+def test_lock_blocking_cond_wait_on_held_lock_allowed():
+    active, _ = lint_source(_src("""
+        class C:
+            def pop(self):
+                with self._cond:
+                    self._cond.wait()
+                with self._mtx:
+                    self._other.wait()
+    """), "txflow_tpu/x.py")
+    # waiting on the condition you hold releases it (sanctioned); waiting
+    # on anything else while holding a lock is the classic stall
+    assert _rules(active) == ["lock-blocking"]
+    assert "_other" in active[0].message
+
+
+def test_suppression_honored_and_recorded():
+    active, suppressed = lint_source(_src("""
+        class C:
+            def send(self, frame):
+                with self._wlock:
+                    self.sock.sendall(frame)  # txlint: allow(lock-blocking) -- wlock exists to serialize frame writes
+    """), "txflow_tpu/x.py")
+    assert active == []
+    assert _rules(suppressed) == ["lock-blocking"]
+    assert suppressed[0].justification.startswith("wlock exists")
+
+
+def test_suppressed_seed_does_not_taint_callers():
+    active, suppressed = lint_source(_src("""
+        class C:
+            def _flush(self):
+                self.wal.write(b"x")  # txlint: allow(lock-blocking) -- append order must match insert order
+
+            def ingest(self, tx):
+                with self._mtx:
+                    self._flush()
+    """), "txflow_tpu/x.py")
+    # sanctioning the seed sanctions the chain that reaches it
+    assert active == []
+
+
+def test_bad_suppression_missing_justification():
+    active, _ = lint_source(_src("""
+        class C:
+            def send(self, frame):
+                with self._mtx:
+                    self.sock.sendall(frame)  # txlint: allow(lock-blocking)
+    """), "txflow_tpu/x.py")
+    # the allow() without a justification is itself flagged AND does not
+    # suppress the underlying violation
+    assert sorted(_rules(active)) == ["bad-suppression", "lock-blocking"]
+
+
+def test_bad_suppression_unknown_rule():
+    active, _ = lint_source(_src("""
+        x = 1  # txlint: allow(made-up-rule) -- because
+    """), "txflow_tpu/x.py")
+    assert _rules(active) == ["bad-suppression"]
+    assert "made-up-rule" in active[0].message
+
+
+# ---------------------------------------------------------------------------
+# nondeterminism
+# ---------------------------------------------------------------------------
+
+_CLOCK_SRC = _src("""
+    import time
+
+    def stamp():
+        return time.time_ns()
+""")
+
+
+def test_nondeterminism_wall_clock_in_consensus_scope():
+    active, _ = lint_source(_CLOCK_SRC, "txflow_tpu/consensus/state.py")
+    assert _rules(active) == ["nondeterminism"]
+    assert "utils.clock" in active[0].message
+
+
+def test_nondeterminism_out_of_scope_is_fine():
+    active, _ = lint_source(_CLOCK_SRC, "txflow_tpu/p2p/switch.py")
+    assert active == []
+
+
+def test_nondeterminism_clock_seam_allowed():
+    active, _ = lint_source(_src("""
+        from ..utils.clock import now_ns
+
+        def stamp():
+            return now_ns()
+    """), "txflow_tpu/consensus/state.py")
+    assert active == []
+
+
+def test_nondeterminism_unseeded_rng_and_set_iteration():
+    active, _ = lint_source(_src("""
+        import random
+
+        def pick(peers):
+            r = random.Random(42)          # seeded: fine
+            random.shuffle(peers)          # process-global rng: flagged
+            for p in set(peers):           # set order: flagged
+                pass
+    """), "txflow_tpu/consensus/reactor.py")
+    assert sorted(_rules(active)) == ["nondeterminism", "nondeterminism"]
+
+
+# ---------------------------------------------------------------------------
+# thread-join
+# ---------------------------------------------------------------------------
+
+
+def test_thread_join_leaked_thread():
+    active, _ = lint_source(_src("""
+        import threading
+
+        class Worker:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+    """), "txflow_tpu/x.py")
+    assert _rules(active) == ["thread-join"]
+
+
+def test_thread_join_daemon_or_joined_ok():
+    active, _ = lint_source(_src("""
+        import threading
+
+        class A:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+        class B:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def stop(self):
+                self._t.join()
+    """), "txflow_tpu/x.py")
+    assert active == []
+
+
+# ---------------------------------------------------------------------------
+# hotpath-sync
+# ---------------------------------------------------------------------------
+
+_HOT_SRC = _src("""
+    class TxFlow:
+        def _collect(self, prep, ticket):
+            n = ticket.count.item()
+            return n
+
+        def stats(self):
+            return self.total.item()
+""")
+
+
+def test_hotpath_sync_in_engine_hot_func():
+    active, _ = lint_source(_HOT_SRC, "txflow_tpu/engine/txflow.py")
+    # .item() in _collect (hot) fires; in stats() (cold) it does not
+    assert _rules(active) == ["hotpath-sync"]
+    assert "_collect" in active[0].message
+
+
+def test_hotpath_sync_other_modules_exempt():
+    active, _ = lint_source(_HOT_SRC, "txflow_tpu/verifier.py")
+    assert active == []
+
+
+# ---------------------------------------------------------------------------
+# unlocked-lru
+# ---------------------------------------------------------------------------
+
+
+def test_unlocked_lru_direct_construction_flagged():
+    active, _ = lint_source(_src("""
+        from ..utils.cache import UnlockedLRUCache
+
+        class Pool:
+            def __init__(self):
+                self.cache = UnlockedLRUCache(100)
+    """), "txflow_tpu/pool/x.py")
+    assert _rules(active) == ["unlocked-lru"]
+    assert "make_lru" in active[0].message
+
+
+def test_unlocked_lru_factory_module_exempt():
+    active, _ = lint_source(
+        "c = UnlockedLRUCache(10)\n", "txflow_tpu/utils/cache.py"
+    )
+    assert active == []
+
+
+# ---------------------------------------------------------------------------
+# twin-path
+# ---------------------------------------------------------------------------
+
+
+def _twin_repo(tmp_path: Path) -> tuple[Path, Path]:
+    root = tmp_path / "repo"
+    (root / "pkg").mkdir(parents=True)
+    (root / "tests").mkdir()
+    (root / "pkg" / "pool.py").write_text(_src("""
+        class Pool:
+            def check_tx(self, tx):
+                return tx * 1
+
+            def check_tx_many(self, txs):
+                return [t * 1 for t in txs]
+    """))
+    (root / "tests" / "test_parity.py").write_text("def test_parity(): pass\n")
+    pin_file = tmp_path / "twins.json"
+    pin_file.write_text(json.dumps({
+        "twins": {
+            "pool-ingest": {
+                "functions": {
+                    "pkg/pool.py::Pool.check_tx": None,
+                    "pkg/pool.py::Pool.check_tx_many": None,
+                },
+                "parity_tests": {"tests/test_parity.py": None},
+            }
+        }
+    }))
+    update_pins(root, pin_file)
+    return root, pin_file
+
+
+def test_twin_path_clean_after_pinning(tmp_path):
+    root, pin_file = _twin_repo(tmp_path)
+    assert TwinPathPass(pin_file).finalize(root) == []
+
+
+def test_twin_path_twin_changed_without_parity_test(tmp_path):
+    # change one twin, leave the parity test alone -> hard failure
+    root, pin_file = _twin_repo(tmp_path)
+    src = root / "pkg" / "pool.py"
+    src.write_text(src.read_text().replace("tx * 1", "tx * 2", 1))
+    out = TwinPathPass(pin_file).finalize(root)
+    assert _rules(out) == ["twin-path"]
+    assert "byte-identical" in out[0].message
+
+
+def test_twin_path_paired_change_wants_repin_then_passes(tmp_path):
+    root, pin_file = _twin_repo(tmp_path)
+    (root / "pkg" / "pool.py").write_text(
+        (root / "pkg" / "pool.py").read_text().replace("* 1", "* 2")
+    )
+    test_f = root / "tests" / "test_parity.py"
+    test_f.write_text(test_f.read_text() + "def test_more(): pass\n")
+    out = TwinPathPass(pin_file).finalize(root)
+    assert _rules(out) == ["twin-path"]
+    assert "--update-pins" in out[0].message
+    update_pins(root, pin_file)
+    assert TwinPathPass(pin_file).finalize(root) == []
+
+
+def test_twin_path_missing_target(tmp_path):
+    root, pin_file = _twin_repo(tmp_path)
+    (root / "pkg" / "pool.py").write_text("class Pool:\n    pass\n")
+    out = TwinPathPass(pin_file).finalize(root)
+    assert _rules(out) == ["twin-path"]
+    assert "not found" in out[0].message
+
+
+def test_committed_pins_are_recorded():
+    """The committed twins.json must carry real fingerprints (null pins
+    would make the pass vacuous) and point at files that exist."""
+    pins = json.loads(
+        (REPO_ROOT / "txflow_tpu" / "analysis" / "twins.json").read_text()
+    )
+    assert pins["twins"], "no twin groups registered"
+    for twin in pins["twins"].values():
+        for spec, fp in twin["functions"].items():
+            assert fp, f"unrecorded pin for {spec} — run tools/lint.py --update-pins"
+            assert (REPO_ROOT / spec.partition("::")[0]).exists()
+        for rel, fp in twin["parity_tests"].items():
+            assert fp and (REPO_ROOT / rel).exists()

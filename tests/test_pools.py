@@ -69,15 +69,23 @@ def test_unlocked_lru_cache_matches_locked_and_guards_free_threading():
         assert (key in u) == (key in l)
 
     # simulate a free-threaded build: construction transparently degrades
-    # to the locked implementation (same API, GIL-independent safety)
-    orig = cache_mod._gil_enabled
-    cache_mod._gil_enabled = lambda: False
+    # to the locked implementation (same API, GIL-independent safety).
+    # The GIL is a property of the interpreter launch, so it is weighed
+    # ONCE at import (_GIL_ENABLED) — patch the constant, not the probe.
+    orig = cache_mod._GIL_ENABLED
+    cache_mod._GIL_ENABLED = False
     try:
         fallback = UnlockedLRUCache(3)
         assert isinstance(fallback, LRUCache)
         assert fallback.push(b"x") and not fallback.push(b"x")
+        assert isinstance(cache_mod.make_lru(3), LRUCache)
     finally:
-        cache_mod._gil_enabled = orig
+        cache_mod._GIL_ENABLED = orig
+
+    # make_lru is the one construction seam (txlint unlocked-lru rule):
+    # GIL build -> owner-serialized unlocked cache; size<=0 -> NopCache
+    assert isinstance(cache_mod.make_lru(3), UnlockedLRUCache)
+    assert isinstance(cache_mod.make_lru(0), cache_mod.NopCache)
 
 
 # ---- TxVotePool ----

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""txlint CLI — project-invariant static analysis for txflow-tpu.
+
+Usage:
+    python tools/lint.py              # human-readable report, exit 0
+    python tools/lint.py --check     # exit 1 on any unsuppressed violation
+    python tools/lint.py --json      # machine-readable report (profile_host)
+    python tools/lint.py --suppressed  # also list suppressed violations
+    python tools/lint.py --update-pins # re-record twin-path fingerprints
+
+Rules, suppression syntax, and the runtime lock auditor are documented in
+README.md "Static analysis & concurrency hygiene".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from txflow_tpu.analysis import core  # noqa: E402
+from txflow_tpu.analysis import twins  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="txlint", description=__doc__)
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero on any unsuppressed violation")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the full report as JSON")
+    ap.add_argument("--suppressed", action="store_true",
+                    help="also print suppressed violations")
+    ap.add_argument("--update-pins", action="store_true",
+                    help="re-record twin-path fingerprints in twins.json")
+    args = ap.parse_args(argv)
+
+    if args.update_pins:
+        pins = twins.update_pins(REPO_ROOT)
+        print(f"re-pinned {len(pins['twins'])} twin group(s) -> {twins.PIN_FILE}")
+        return 0
+
+    report = core.lint_tree(REPO_ROOT)
+    if args.as_json:
+        json.dump(core.report_to_json(report), sys.stdout, indent=2)
+        print()
+    else:
+        for v in report["violations"]:
+            print(v.format())
+        if args.suppressed:
+            for v in report["suppressed"]:
+                print(f"{v.format()} -- {v.justification}")
+        for e in report["errors"]:
+            print(f"ERROR: {e}", file=sys.stderr)
+        n, s = len(report["violations"]), len(report["suppressed"])
+        print(
+            f"txlint: {report['files_scanned']} files, "
+            f"{n} violation(s), {s} suppressed"
+        )
+    if report["errors"]:
+        return 2
+    if args.check and report["violations"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

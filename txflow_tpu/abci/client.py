@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import socket
 import threading
+
+from ..analysis.lockgraph import make_rlock, note_blocking
 from dataclasses import dataclass
 
 from . import wire
@@ -40,7 +42,7 @@ class _SocketConn:
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.settimeout(timeout)
         self._wf = self._sock.makefile("wb")
-        self._mtx = threading.RLock()  # serializes request writes + reads
+        self._mtx = make_rlock("abci.SocketClient._mtx", allow_blocking=True)  # serializes request writes + reads
         self._pending: list[_Pending] = []
         self._error: Exception | None = None
 
@@ -96,6 +98,10 @@ class _SocketConn:
         ``.value`` instead.
         """
         cbs: list = []
+        # the whole round trip blocks on the app process: callers must not
+        # hold any OTHER lock here (self._mtx itself is allow_blocking —
+        # it exists to serialize the request/response stream)
+        note_blocking("abci.socket-roundtrip")
         try:
             with self._mtx:
                 try:

@@ -1,0 +1,30 @@
+"""txlint: project-invariant static analysis + runtime lock auditing.
+
+Static side (``core`` + ``passes`` + ``twins``, driven by ``tools/lint.py``
+and gated by ``tests/test_lint.py``): AST passes that mechanically enforce
+the concurrency/determinism invariants this repo's hot path depends on —
+no blocking call under a lock, no wall-clock/rng in consensus-critical
+modules, every thread daemonized or joined, no host-sync in the pipelined
+engine loops, lock-free LRU construction routed through the one factory
+that owns the GIL assumption, and hand-synced twin code paths pinned to
+their parity tests.
+
+Runtime side (``lockgraph``): an opt-in audited lock wrapper
+(``TXFLOW_LOCK_AUDIT=1``) that records the cross-thread lock acquisition
+graph, flags ordering cycles (potential deadlocks) and blocking calls made
+while holding a lock.
+
+Import surface is deliberately split: ``lockgraph`` is imported by hot
+runtime modules (engine/pools/p2p) and stays dependency-light; the AST
+machinery is only pulled in by the lint tooling.
+"""
+
+from .lockgraph import (  # noqa: F401
+    LockAuditor,
+    audit_enabled,
+    default_auditor,
+    make_lock,
+    make_rlock,
+    note_blocking,
+    sanctioned_blocking,
+)

@@ -1,0 +1,230 @@
+"""txlint core: violation model, suppression parsing, pass driver.
+
+A *pass* inspects one parsed module and yields ``Violation`` objects; the
+driver attaches suppressions and splits the result into active vs
+suppressed. Suppressions are source comments:
+
+    <flagged line>  # txlint: allow(lock-blocking) -- one-line justification
+
+- the comment suppresses the named rule(s) (comma-separated, or ``*``)
+  for any violation whose flagged node overlaps that physical line;
+- the ``-- justification`` part is REQUIRED: an allow() without one is
+  itself a violation (rule ``bad-suppression``), so every suppression in
+  the tree documents why the invariant doesn't apply. Unknown rule ids
+  are flagged the same way.
+
+Passes are registered in ``passes.py`` / ``twins.py``; ``tools/lint.py``
+is the CLI and ``tests/test_lint.py`` the tier-1 gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+
+RULES = {
+    "lock-blocking": "blocking call while holding a Lock/RLock",
+    "nondeterminism": "wall-clock/rng/set-order dependence in a consensus-critical module",
+    "thread-join": "Thread neither daemonized nor joined on a stop()/close() path",
+    "hotpath-sync": "host-sync / recompile hazard inside a pipelined engine loop",
+    "unlocked-lru": "direct UnlockedLRUCache construction outside utils.cache.make_lru",
+    "twin-path": "hand-synced twin changed without its registered parity test",
+    "bad-suppression": "txlint suppression without a justification or with an unknown rule",
+}
+
+_ALLOW_RE = re.compile(
+    r"#\s*txlint:\s*allow\(([^)]*)\)(?:\s*--\s*(\S.*))?"
+)
+
+
+@dataclasses.dataclass
+class Violation:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    message: str
+    suppressed: bool = False
+    justification: str = ""
+
+    def format(self) -> str:
+        tag = " [suppressed]" if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}{tag}"
+
+
+@dataclasses.dataclass
+class _Suppression:
+    line: int
+    rules: set[str]  # {"*"} = all
+    justification: str
+
+    def covers(self, rule: str) -> bool:
+        return "*" in self.rules or rule in self.rules
+
+
+class ModuleSource:
+    """One parsed module: source text, AST, and its suppression table."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path  # repo-relative, forward slashes
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.suppressions: list[_Suppression] = []
+        self.suppression_errors: list[Violation] = []
+        for i, line in enumerate(self.lines, 1):
+            m = _ALLOW_RE.search(line)
+            if m is None:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            just = (m.group(2) or "").strip()
+            bad = [r for r in rules if r != "*" and r not in RULES]
+            if not just:
+                self.suppression_errors.append(
+                    Violation(
+                        "bad-suppression", path, i,
+                        "allow() needs a justification after `--`, e.g. "
+                        "allow(lock-blocking) -- write lock exists to serialize this",
+                    )
+                )
+            elif bad:
+                self.suppression_errors.append(
+                    Violation(
+                        "bad-suppression", path, i,
+                        f"unknown rule id(s) {sorted(bad)} in allow()",
+                    )
+                )
+            else:
+                self.suppressions.append(_Suppression(i, rules, just))
+
+    def suppression_for(
+        self, rule: str, lineno: int, end_lineno: int | None = None
+    ) -> _Suppression | None:
+        """A suppression covers a violation when it sits on any physical
+        line the flagged node spans (clamped to a few lines so a comment
+        deep inside a big block can't blanket the whole block)."""
+        end = min(end_lineno or lineno, lineno + 4)
+        for s in self.suppressions:
+            if lineno <= s.line <= end and s.covers(rule):
+                return s
+        return None
+
+    def line_suppressed(self, rule: str, lineno: int) -> bool:
+        return self.suppression_for(rule, lineno) is not None
+
+
+class LintPass:
+    """Base: subclasses set ``name`` and implement run(module) -> list."""
+
+    name = "base"
+
+    def run(self, module: ModuleSource) -> list[Violation]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finalize(self, repo_root: Path) -> list[Violation]:
+        """Tree-level checks after every module ran (twin pins)."""
+        return []
+
+
+def default_passes() -> list[LintPass]:
+    from . import passes as _p
+    from .twins import TwinPathPass
+
+    return [
+        _p.LockDisciplinePass(),
+        _p.DeterminismPass(),
+        _p.ThreadLifecyclePass(),
+        _p.HotPathPass(),
+        _p.UnlockedLRUPass(),
+        TwinPathPass(),
+    ]
+
+
+def iter_source_files(repo_root: Path) -> list[Path]:
+    """The lint scope: the package itself. Tests/tools/bench are allowed
+    to sleep, join, and use wall clocks freely."""
+    pkg = repo_root / "txflow_tpu"
+    return sorted(p for p in pkg.rglob("*.py"))
+
+
+def lint_tree(
+    repo_root: Path, lint_passes: list[LintPass] | None = None
+) -> dict:
+    """Run all passes over the tree. Returns a report dict:
+    {"violations": [...active...], "suppressed": [...], "errors": [...],
+    "files_scanned": n}."""
+    repo_root = Path(repo_root)
+    lint_passes = lint_passes if lint_passes is not None else default_passes()
+    active: list[Violation] = []
+    suppressed: list[Violation] = []
+    errors: list[str] = []
+    n_files = 0
+    for path in iter_source_files(repo_root):
+        rel = path.relative_to(repo_root).as_posix()
+        try:
+            module = ModuleSource(rel, path.read_text())
+        except SyntaxError as e:  # pragma: no cover - tree always parses
+            errors.append(f"{rel}: syntax error: {e}")
+            continue
+        n_files += 1
+        active.extend(module.suppression_errors)
+        for p in lint_passes:
+            for v in p.run(module):
+                s = module.suppression_for(v.rule, v.line)
+                if s is not None:
+                    v.suppressed = True
+                    v.justification = s.justification
+                    suppressed.append(v)
+                else:
+                    active.append(v)
+    for p in lint_passes:
+        active.extend(p.finalize(repo_root))
+    active.sort(key=lambda v: (v.path, v.line, v.rule))
+    suppressed.sort(key=lambda v: (v.path, v.line, v.rule))
+    return {
+        "violations": active,
+        "suppressed": suppressed,
+        "errors": errors,
+        "files_scanned": n_files,
+    }
+
+
+def lint_source(
+    text: str, virtual_path: str, lint_passes: list[LintPass] | None = None
+) -> tuple[list[Violation], list[Violation]]:
+    """Lint one source string as if it lived at virtual_path (module-scoped
+    passes key off the path). Fixture-test entry point. Returns
+    (active, suppressed)."""
+    module = ModuleSource(virtual_path, text)
+    lint_passes = lint_passes if lint_passes is not None else default_passes()
+    active: list[Violation] = list(module.suppression_errors)
+    suppressed: list[Violation] = []
+    for p in lint_passes:
+        for v in p.run(module):
+            s = module.suppression_for(v.rule, v.line)
+            if s is not None:
+                v.suppressed = True
+                v.justification = s.justification
+                suppressed.append(v)
+            else:
+                active.append(v)
+    return active, suppressed
+
+
+def report_to_json(report: dict) -> dict:
+    return {
+        "files_scanned": report["files_scanned"],
+        "errors": report["errors"],
+        "counts": _counts(report["violations"]),
+        "suppressed_counts": _counts(report["suppressed"]),
+        "violations": [dataclasses.asdict(v) for v in report["violations"]],
+        "suppressed": [dataclasses.asdict(v) for v in report["suppressed"]],
+    }
+
+
+def _counts(violations: list[Violation]) -> dict:
+    out: dict[str, int] = {}
+    for v in violations:
+        out[v.rule] = out.get(v.rule, 0) + 1
+    return out

@@ -1,0 +1,426 @@
+"""Runtime lock-order auditor (the dynamic half of txlint).
+
+Static analysis sees lexical lock scopes; it cannot see an acquisition
+ORDER inverted across two call chains, or a blocking call reached three
+frames below a ``with self._mtx:``. This module closes that gap with an
+opt-in instrumented lock:
+
+- ``make_lock(name)`` / ``make_rlock(name)`` return plain
+  ``threading.Lock``/``RLock`` objects unless ``TXFLOW_LOCK_AUDIT=1`` is
+  set in the environment (checked at construction — zero overhead when
+  off, which is the production default). When auditing is on they return
+  wrappers that record, per thread, the stack of held audited locks and,
+  globally, every (held -> acquired) edge of the acquisition graph.
+- ``LockAuditor.cycles()`` finds cycles in that graph: two threads that
+  ever acquire the same two locks in opposite orders are one unlucky
+  preemption away from deadlock, even if the test run never deadlocked.
+- ``note_blocking(desc)`` is the blocking-call probe: call sites that
+  perform known-blocking work (socket round trips, device readbacks,
+  ``time.sleep`` via ``install_probes()``) report themselves, and the
+  auditor records a violation when any audited lock is held — unless the
+  lock was constructed with ``allow_blocking=True``, the explicit marker
+  for locks whose JOB is to serialize a blocking region (a connection
+  write lock, a signer's request lock, a store's durability point).
+
+tier-1 enables auditing via ``tests/conftest.py`` and fails the run on
+any cycle or blocking violation (see ``pytest_sessionfinish`` there).
+
+The wrappers implement the private ``threading.Condition`` protocol
+(``_release_save`` / ``_acquire_restore`` / ``_is_owned``) so an audited
+RLock can back a Condition (pool ingest logs do this); a ``wait()``
+releases the lock, so the held-stack bookkeeping mirrors that.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+import traceback
+
+_ENV = "TXFLOW_LOCK_AUDIT"
+
+# bound the edge/violation tables so a pathological run cannot grow them
+# without limit; real graphs are tiny (one node per lock SITE, not instance
+# count x threads)
+_MAX_EDGES = 100_000
+_MAX_VIOLATIONS = 1_000
+
+
+def audit_enabled() -> bool:
+    """True when TXFLOW_LOCK_AUDIT=1 — re-read per call so conftest can
+    set it before any lock is constructed, without import-order games."""
+    return os.environ.get(_ENV, "") == "1"
+
+
+class LockAuditor:
+    """Acquisition-graph recorder shared by every audited lock.
+
+    Nodes are lock INSTANCES (a monotonic token per wrapper — ids would
+    be reused after GC and could fabricate phantom cycles); names label
+    them in reports. A cycle among instances is a real deadlock order,
+    not a same-name coincidence across independent object graphs (two
+    nodes of a LocalNet each own a pool lock named "pool.Mempool";
+    opposite orders across *different* nodes' locks are harmless and must
+    not fire)."""
+
+    def __init__(self) -> None:
+        self._mtx = threading.Lock()  # guards the tables below, never held
+        # while user code runs — acquire/record/release only
+        self._tls = threading.local()
+        self._names: dict[int, str] = {}  # token -> name
+        self._edges: dict[tuple[int, int], int] = {}  # (held, acquired) -> count
+        self._edge_sites: dict[tuple[int, int], str] = {}
+        self._violations: list[dict] = []
+        self._tokens = itertools.count(1)
+
+    # -- wrapper callbacks --
+
+    def register(self, name: str) -> int:
+        tok = next(self._tokens)
+        with self._mtx:
+            self._names[tok] = name
+        return tok
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def note_acquire(self, lock: "_AuditedLockBase") -> None:
+        held = self._held()
+        if held:
+            tok = lock._tok
+            new_edges = []
+            for h in held:
+                if h._tok != tok:
+                    new_edges.append((h._tok, tok))
+            if new_edges:
+                site = None
+                with self._mtx:
+                    for e in new_edges:
+                        n = self._edges.get(e)
+                        if n is None and len(self._edges) >= _MAX_EDGES:
+                            continue
+                        self._edges[e] = (n or 0) + 1
+                        if n is None:
+                            if site is None:
+                                site = _short_stack()
+                            self._edge_sites[e] = site
+        held.append(lock)
+
+    def note_release(self, lock: "_AuditedLockBase") -> None:
+        held = self._held()
+        # release order can differ from acquire order (rare but legal);
+        # remove the newest matching entry
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    def drop_all(self, lock: "_AuditedLockBase") -> int:
+        """Condition _release_save: an RLock's wait() releases EVERY
+        recursion level at once. Returns how many entries were dropped so
+        _acquire_restore can push them back."""
+        held = self._held()
+        n = 0
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                n += 1
+        return n
+
+    def push_n(self, lock: "_AuditedLockBase", n: int) -> None:
+        for _ in range(max(n, 1)):
+            self.note_acquire(lock)
+
+    # -- probes --
+
+    def note_blocking(self, desc: str) -> None:
+        """Record a violation if the calling thread holds any audited lock
+        not marked allow_blocking."""
+        held = getattr(self._tls, "held", None)
+        if not held:
+            return
+        if getattr(self._tls, "sanctioned", 0):
+            return  # inside a sanctioned_blocking() region
+        bad = [l for l in held if not l._allow_blocking]
+        if not bad:
+            return
+        with self._mtx:
+            if len(self._violations) >= _MAX_VIOLATIONS:
+                return
+            self._violations.append(
+                {
+                    "desc": desc,
+                    "held": [l._name for l in bad],
+                    "thread": threading.current_thread().name,
+                    "stack": _short_stack(),
+                }
+            )
+
+    # -- reporting --
+
+    def cycles(self) -> list[list[str]]:
+        """Cycles in the acquisition graph, as name lists. Iterative DFS
+        with an on-path set; one cycle reported per back edge found."""
+        with self._mtx:
+            edges = list(self._edges)
+            names = dict(self._names)
+        adj: dict[int, list[int]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+        out: list[list[str]] = []
+        seen_cycles: set[tuple] = set()
+        visited: set[int] = set()
+        for root in list(adj):
+            if root in visited:
+                continue
+            # stack of (node, iterator over successors); path = on-stack nodes
+            path: list[int] = []
+            on_path: set[int] = set()
+            stack = [(root, iter(adj.get(root, ())))]
+            path.append(root)
+            on_path.add(root)
+            while stack:
+                node, it = stack[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt in on_path:
+                        i = path.index(nxt)
+                        cyc = path[i:] + [nxt]
+                        key = tuple(sorted(set(cyc)))
+                        if key not in seen_cycles:
+                            seen_cycles.add(key)
+                            out.append([names.get(t, f"lock#{t}") for t in cyc])
+                        continue
+                    if nxt in visited:
+                        continue
+                    stack.append((nxt, iter(adj.get(nxt, ()))))
+                    path.append(nxt)
+                    on_path.add(nxt)
+                    advanced = True
+                    break
+                if not advanced:
+                    stack.pop()
+                    visited.add(node)
+                    on_path.discard(node)
+                    path.pop()
+        return out
+
+    def blocking_violations(self) -> list[dict]:
+        with self._mtx:
+            return list(self._violations)
+
+    def report(self) -> dict:
+        with self._mtx:
+            names = dict(self._names)
+            edges = [
+                {
+                    "from": names.get(a, f"lock#{a}"),
+                    "to": names.get(b, f"lock#{b}"),
+                    "count": n,
+                    "first_site": self._edge_sites.get((a, b), ""),
+                }
+                for (a, b), n in self._edges.items()
+            ]
+            violations = list(self._violations)
+        return {
+            "locks": sorted(set(names.values())),
+            "edges": edges,
+            "cycles": self.cycles(),
+            "blocking_violations": violations,
+        }
+
+    def reset(self) -> None:
+        with self._mtx:
+            self._edges.clear()
+            self._edge_sites.clear()
+            self._violations.clear()
+
+
+_DEFAULT = LockAuditor()
+
+
+def default_auditor() -> LockAuditor:
+    return _DEFAULT
+
+
+class _AuditedLockBase:
+    _name: str
+    _tok: int
+    _allow_blocking: bool
+    _auditor: LockAuditor
+
+    def __init__(self, name: str, allow_blocking: bool, auditor: LockAuditor | None):
+        self._name = name
+        self._allow_blocking = allow_blocking
+        self._auditor = auditor if auditor is not None else _DEFAULT
+        self._tok = self._auditor.register(name)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._auditor.note_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        self._auditor.note_release(self)
+        self._inner.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def _at_fork_reinit(self) -> None:
+        self._inner._at_fork_reinit()
+
+    def __repr__(self) -> str:
+        return f"<audited {type(self._inner).__name__} {self._name!r}>"
+
+
+class AuditedLock(_AuditedLockBase):
+    def __init__(
+        self,
+        name: str,
+        allow_blocking: bool = False,
+        auditor: LockAuditor | None = None,
+    ):
+        self._inner = threading.Lock()
+        super().__init__(name, allow_blocking, auditor)
+
+    # Condition protocol (a plain Lock has no _is_owned; Condition falls
+    # back to a try-acquire probe when these are absent, so provide the
+    # pair that must exist for correct bookkeeping)
+    def _release_save(self):
+        n = self._auditor.drop_all(self)
+        self._inner.release()
+        return n
+
+    def _acquire_restore(self, n) -> None:
+        self._inner.acquire()
+        self._auditor.push_n(self, n if isinstance(n, int) else 1)
+
+
+class AuditedRLock(_AuditedLockBase):
+    def __init__(
+        self,
+        name: str,
+        allow_blocking: bool = False,
+        auditor: LockAuditor | None = None,
+    ):
+        self._inner = threading.RLock()
+        super().__init__(name, allow_blocking, auditor)
+
+    def locked(self) -> bool:  # RLock has no locked() before 3.12's _is_owned
+        return self._inner._is_owned()
+
+    # Condition protocol: delegate to the real RLock (which releases all
+    # recursion levels in _release_save) and mirror in the held stack
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        n = self._auditor.drop_all(self)
+        state = self._inner._release_save()
+        return (state, n)
+
+    def _acquire_restore(self, saved) -> None:
+        state, n = saved
+        self._inner._acquire_restore(state)
+        self._auditor.push_n(self, n)
+
+
+def make_lock(name: str, allow_blocking: bool = False):
+    """A threading.Lock, audited when TXFLOW_LOCK_AUDIT=1.
+
+    allow_blocking marks locks that intentionally guard blocking work
+    (serialized socket writes, fsync points): note_blocking() under them
+    is sanctioned and not reported."""
+    if audit_enabled():
+        return AuditedLock(name, allow_blocking)
+    return threading.Lock()
+
+
+def make_rlock(name: str, allow_blocking: bool = False):
+    """A threading.RLock, audited when TXFLOW_LOCK_AUDIT=1."""
+    if audit_enabled():
+        return AuditedRLock(name, allow_blocking)
+    return threading.RLock()
+
+
+def note_blocking(desc: str) -> None:
+    """Blocking-call probe for the default auditor. Cheap no-op when
+    nothing is held or auditing is off (the thread-local held list only
+    ever populates via audited locks)."""
+    _DEFAULT.note_blocking(desc)
+
+
+class _Sanction:
+    """Thread-scoped sanction: probes inside the region don't report.
+    The runtime counterpart of a static ``allow(lock-blocking)``
+    suppression comment — for regions where holding a lock across
+    blocking work IS the contract (the app-Commit fence under the
+    mempool lock)."""
+
+    __slots__ = ("_aud",)
+
+    def __init__(self, aud: LockAuditor):
+        self._aud = aud
+
+    def __enter__(self) -> "_Sanction":
+        tls = self._aud._tls
+        tls.sanctioned = getattr(tls, "sanctioned", 0) + 1
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._aud._tls.sanctioned -= 1
+
+
+def sanctioned_blocking(justification: str, auditor: LockAuditor | None = None) -> _Sanction:
+    """Context manager marking a deliberate lock-held-across-blocking
+    region. `justification` is required (and deliberately unused): the
+    call site must say WHY, exactly like a static suppression comment."""
+    assert justification, "sanctioned_blocking() requires a justification"
+    return _Sanction(auditor if auditor is not None else _DEFAULT)
+
+
+_probes_installed = False
+_orig_sleep = time.sleep
+
+
+def install_probes() -> None:
+    """Patch time.sleep to self-report through note_blocking. Idempotent;
+    test-only (conftest), never called on production paths."""
+    global _probes_installed
+    if _probes_installed:
+        return
+    _probes_installed = True
+
+    def _audited_sleep(secs):
+        _DEFAULT.note_blocking(f"time.sleep({secs!r})")
+        _orig_sleep(secs)
+
+    time.sleep = _audited_sleep
+
+
+def uninstall_probes() -> None:
+    global _probes_installed
+    if _probes_installed:
+        time.sleep = _orig_sleep
+        _probes_installed = False
+
+
+def _short_stack(limit: int = 6) -> str:
+    """Compact call-site summary: the few frames above the lock wrapper,
+    file:line only (full stacks bloat reports and pin test internals)."""
+    frames = traceback.extract_stack()[:-3]
+    tail = frames[-limit:]
+    return " <- ".join(f"{os.path.basename(f.filename)}:{f.lineno}" for f in reversed(tail))

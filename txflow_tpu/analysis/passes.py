@@ -1,0 +1,489 @@
+"""txlint static passes (see core.RULES for the rule inventory).
+
+Every pass is heuristic AST analysis tuned to THIS repo's idioms — lock
+attributes are named ``*_mtx``/``*_lock``/``*_cond``, blocking surfaces
+are a known vocabulary (ticket.result, sendall, check_tx_sync, save_tx,
+...), hot loops live in named TxFlow methods. The goal is a zero-noise
+gate over this tree, not a general-purpose linter: false negatives are
+the runtime auditor's job (analysis.lockgraph), false positives are
+suppressed inline with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import LintPass, ModuleSource, Violation
+
+# ---------------------------------------------------------------------------
+# lock-blocking
+# ---------------------------------------------------------------------------
+
+# attribute names that read as a mutex when used in `with ...:`
+_LOCK_SEGMENTS = {"mtx", "mu", "lock", "rlock", "wlock", "lk", "cv", "cond", "condition"}
+
+# receiver-name patterns
+_QUEUE_RE = re.compile(r"(^|[._])(q|queue|jobs|inbox|outbox)$|queue", re.I)
+_SOCKISH_RE = re.compile(r"sock|conn|peer", re.I)
+_WAL_RE = re.compile(r"wal", re.I)
+
+# method names that are a blocking round trip / durability point wherever
+# they appear (socket ABCI calls, store writes, pool condition waits)
+_BLOCKING_ATTRS = {
+    "check_tx_sync": "ABCI CheckTx round trip",
+    "deliver_tx_sync": "ABCI DeliverTx round trip",
+    "commit_sync": "ABCI Commit round trip",
+    "flush_sync": "ABCI Flush round trip",
+    "query_sync": "ABCI Query round trip",
+    "info_sync": "ABCI Info round trip",
+    "apply_tx": "ABCI apply round trip",
+    "apply_tx_batch": "ABCI apply round trip",
+    "save_tx": "store write (fsync at height edges)",
+    "save_txs_batch": "store write (fsync at height edges)",
+    "set_many": "db batch write (possible fsync)",
+    "mark_block_committed": "store write",
+    "wait_for_new": "pool condition wait",
+    "block_until_ready": "device sync",
+    "sendall": "socket write",
+    "recv": "socket read",
+    "recv_into": "socket read",
+    "accept": "socket accept",
+}
+
+
+def _expr_str(node: ast.AST) -> str:
+    """Dotted-name rendering of simple receiver expressions ("self._mtx",
+    "self.pool.cache"); empty string for anything fancier."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_lockish(expr: str) -> bool:
+    last = expr.rsplit(".", 1)[-1]
+    segs = set(last.strip("_").lower().split("_"))
+    if segs & _LOCK_SEGMENTS:
+        return True
+    return last.lower().endswith(("lock", "mtx"))
+
+
+def _numeric_const(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and isinstance(node.value, (int, float))
+
+
+def _blocking_reason(call: ast.Call, held: tuple[str, ...]) -> str | None:
+    """Why this call is blocking, or None. `held` = dotted lock exprs of
+    the enclosing with-blocks (used to allow cond.wait on the held cond)."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        if func.id == "sleep":
+            return "sleep()"
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    recv = _expr_str(func.value)
+    if attr == "sleep":
+        return f"{recv or '?'}.sleep()"
+    if attr == "result" and not call.args and not call.keywords:
+        return "ticket.result() — blocks on the in-flight device verify"
+    if attr in _BLOCKING_ATTRS:
+        return f".{attr}() — {_BLOCKING_ATTRS[attr]}"
+    if attr == "join":
+        # thread-like join: no args, timeout kwarg, or one numeric arg.
+        # (str.join / os.path.join always take a non-numeric argument.)
+        if not call.args and not call.keywords:
+            return ".join() — thread join"
+        if any(k.arg == "timeout" for k in call.keywords):
+            return ".join(timeout=...) — thread join"
+        if len(call.args) == 1 and _numeric_const(call.args[0]):
+            return ".join(t) — thread join"
+        return None
+    if attr == "get" and _QUEUE_RE.search(recv):
+        for k in call.keywords:
+            if (
+                k.arg == "block"
+                and isinstance(k.value, ast.Constant)
+                and k.value.value is False
+            ):
+                return None
+        return f"{recv}.get() — queue wait"
+    if attr == "put" and any(k.arg == "timeout" for k in call.keywords):
+        return f"{recv}.put(timeout=...) — bounded queue wait"
+    if attr in ("send", "connect") and _SOCKISH_RE.search(recv):
+        return f"{recv}.{attr}() — socket/peer I/O"
+    if attr == "write" and _WAL_RE.search(recv):
+        return f"{recv}.write() — WAL append"
+    if attr in ("wait", "wait_for"):
+        # cond.wait() on the lock you hold RELEASES it — that's the one
+        # sanctioned blocking call under a lock
+        if recv and recv in held:
+            return None
+        return f"{recv or '?'}.{attr}() — event/condition wait"
+    return None
+
+
+class LockDisciplinePass(LintPass):
+    """No blocking call while lexically inside `with <lock>:`.
+
+    Two detection layers per class:
+    1. direct: a blocking call (vocabulary above) inside a lock scope;
+    2. taint: a `self.m()` call inside a lock scope where method `m`
+       (fixpoint over same-class `self.` calls) contains an unsuppressed
+       blocking call — catching effects buried one or more frames below
+       the `with`. Suppressing the seed line sanctions the whole chain.
+    """
+
+    name = "lock-blocking"
+
+    def run(self, module: ModuleSource) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._run_class(module, node))
+        # module-level functions (rare; no self-taint possible)
+        for node in module.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._walk_func(module, node, tainted={}, seeds={}))
+        return out
+
+    # -- class-level taint fixpoint --
+
+    def _run_class(self, module: ModuleSource, cls: ast.ClassDef) -> list[Violation]:
+        methods = {
+            n.name: n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # seed: method -> (line, reason) of its first unsuppressed blocking call
+        seeds: dict[str, tuple[int, str]] = {}
+        calls: dict[str, set[str]] = {name: set() for name in methods}
+        for name, fn in methods.items():
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                reason = _blocking_reason(sub, held=())
+                if reason is not None and not module.line_suppressed(
+                    self.name, sub.lineno
+                ):
+                    seeds.setdefault(name, (sub.lineno, reason))
+                f = sub.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self"
+                    and f.attr in methods
+                ):
+                    calls[name].add(f.attr)
+        # fixpoint: tainted = transitively reaches a seed via self. calls
+        tainted: dict[str, tuple[int, str]] = dict(seeds)
+        changed = True
+        while changed:
+            changed = False
+            for name in methods:
+                if name in tainted:
+                    continue
+                for callee in calls[name]:
+                    if callee in tainted:
+                        line, reason = tainted[callee]
+                        tainted[name] = (line, reason)
+                        changed = True
+                        break
+        out: list[Violation] = []
+        for fn in methods.values():
+            out.extend(self._walk_func(module, fn, tainted=tainted, seeds=seeds))
+        return out
+
+    # -- lexical lock-scope walk --
+
+    def _walk_func(
+        self,
+        module: ModuleSource,
+        fn: ast.AST,
+        tainted: dict[str, tuple[int, str]],
+        seeds: dict[str, tuple[int, str]],
+    ) -> list[Violation]:
+        out: list[Violation] = []
+
+        def visit(node: ast.AST, held: tuple[str, ...]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                if node is not fn:
+                    return  # nested defs execute later, outside this scope
+            if isinstance(node, ast.With):
+                new_held = held
+                for item in node.items:
+                    expr = _expr_str(item.context_expr)
+                    if expr and _is_lockish(expr):
+                        new_held = new_held + (expr,)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, new_held)
+                return
+            if isinstance(node, ast.Call) and held:
+                reason = _blocking_reason(node, held)
+                if reason is not None:
+                    out.append(
+                        Violation(
+                            self.name, module.path, node.lineno,
+                            f"{reason} while holding {held[-1]}",
+                        )
+                    )
+                else:
+                    f = node.func
+                    if (
+                        isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "self"
+                        and f.attr in tainted
+                    ):
+                        line, why = tainted[f.attr]
+                        out.append(
+                            Violation(
+                                self.name, module.path, node.lineno,
+                                f"self.{f.attr}() while holding {held[-1]} — "
+                                f"reaches blocking {why} (line {line})",
+                            )
+                        )
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fn.body:
+            visit(stmt, ())
+        return out
+
+
+# ---------------------------------------------------------------------------
+# nondeterminism
+# ---------------------------------------------------------------------------
+
+# consensus-critical scope: certificate contents and commit decisions must
+# be reproducible across nodes/replays
+_DETERMINISM_SCOPE = (
+    "txflow_tpu/types/vote_set.py",
+    "txflow_tpu/engine/txflow.py",
+    "txflow_tpu/consensus/",
+)
+
+_CLOCK_SEAM = "txflow_tpu/utils/clock.py"
+
+
+class DeterminismPass(LintPass):
+    """No wall clock, unseeded rng, or set-iteration-order dependence in
+    consensus-critical modules, except through the utils.clock seam."""
+
+    name = "nondeterminism"
+
+    def run(self, module: ModuleSource) -> list[Violation]:
+        if module.path == _CLOCK_SEAM:
+            return []  # the seam itself wraps the wall clock
+        if not module.path.startswith(_DETERMINISM_SCOPE):
+            return []
+        out: list[Violation] = []
+        seam_names = self._seam_imports(module)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                out.extend(self._check_call(module, node, seam_names))
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                it = node.iter
+                if _is_set_expr(it):
+                    line = getattr(node, "lineno", getattr(it, "lineno", 1))
+                    out.append(
+                        Violation(
+                            self.name, module.path, line,
+                            "iteration over a set — order varies per process "
+                            "(PYTHONHASHSEED); sort or use an ordered container",
+                        )
+                    )
+        return out
+
+    def _seam_imports(self, module: ModuleSource) -> set[str]:
+        """Names bound from utils.clock — calls through them are allowed."""
+        names: set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom) and node.module and (
+                node.module.endswith("utils.clock") or node.module == "clock"
+            ):
+                for a in node.names:
+                    names.add(a.asname or a.name)
+        return names
+
+    def _check_call(
+        self, module: ModuleSource, call: ast.Call, seam: set[str]
+    ) -> list[Violation]:
+        func = call.func
+        name = _expr_str(func) if isinstance(func, (ast.Attribute, ast.Name)) else ""
+        root = name.split(".", 1)[0]
+        if root in seam:
+            return []
+        if name in ("time.time", "time.time_ns"):
+            return [
+                Violation(
+                    self.name, module.path, call.lineno,
+                    f"{name}() in a consensus-critical module — route through "
+                    "utils.clock so replays/tests can pin the clock",
+                )
+            ]
+        if root == "random":
+            # random.Random(seed) is the sanctioned seeded constructor
+            if name == "random.Random" and call.args:
+                return []
+            return [
+                Violation(
+                    self.name, module.path, call.lineno,
+                    f"{name}() — unseeded process-global rng in a "
+                    "consensus-critical module",
+                )
+            ]
+        if root in ("uuid", "secrets") or name == "os.urandom":
+            return [
+                Violation(
+                    self.name, module.path, call.lineno,
+                    f"{name}() — nondeterministic value source in a "
+                    "consensus-critical module",
+                )
+            ]
+        return []
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in ("set", "frozenset")
+    return False
+
+
+# ---------------------------------------------------------------------------
+# thread-join
+# ---------------------------------------------------------------------------
+
+
+class ThreadLifecyclePass(LintPass):
+    """Every Thread(...) created in a class must be daemon=True or joined
+    somewhere in the same class (stop()/close()/join-on-name)."""
+
+    name = "thread-join"
+
+    def run(self, module: ModuleSource) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._run_class(module, node))
+        return out
+
+    def _run_class(self, module: ModuleSource, cls: ast.ClassDef) -> list[Violation]:
+        creations: list[ast.Call] = []
+        joins = False
+        for sub in ast.walk(cls):
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                fname = _expr_str(f) if isinstance(f, (ast.Attribute, ast.Name)) else ""
+                if fname.endswith("Thread") and fname.split(".", 1)[0] in (
+                    "threading", "Thread", "_t",
+                ):
+                    creations.append(sub)
+                elif isinstance(f, ast.Attribute) and f.attr == "join":
+                    joins = True
+        out: list[Violation] = []
+        for call in creations:
+            daemon = any(
+                k.arg == "daemon"
+                and isinstance(k.value, ast.Constant)
+                and k.value.value is True
+                for k in call.keywords
+            )
+            if daemon or joins:
+                continue
+            out.append(
+                Violation(
+                    self.name, module.path, call.lineno,
+                    f"Thread created in {cls.name} is neither daemon=True nor "
+                    "joined anywhere in the class — a leaked thread outlives "
+                    "stop() and keeps the process alive",
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# hotpath-sync
+# ---------------------------------------------------------------------------
+
+# the pipelined engine loops: one host sync here stalls every in-flight
+# ticket behind it (COMPONENTS.md "Verify pipeline")
+_HOT_FUNCS = {
+    "txflow_tpu/engine/txflow.py": {
+        "_run_pipelined", "_form_batch", "step", "_prep_batch",
+        "_submit_prep", "_collect", "_route_result",
+    },
+}
+
+_HOT_ATTRS = {
+    "item": ".item() forces a device->host readback per element",
+    "asarray": "np.asarray on a device array is a blocking transfer",
+    "device_get": "explicit host readback",
+    "block_until_ready": "full device sync",
+}
+
+
+class HotPathPass(LintPass):
+    name = "hotpath-sync"
+
+    def run(self, module: ModuleSource) -> list[Violation]:
+        hot = _HOT_FUNCS.get(module.path)
+        if not hot:
+            return []
+        out: list[Violation] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name not in hot:
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+                    attr = sub.func.attr
+                    if attr in _HOT_ATTRS:
+                        out.append(
+                            Violation(
+                                self.name, module.path, sub.lineno,
+                                f".{attr}() in hot function {node.name}: "
+                                f"{_HOT_ATTRS[attr]}",
+                            )
+                        )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# unlocked-lru
+# ---------------------------------------------------------------------------
+
+
+class UnlockedLRUPass(LintPass):
+    """UnlockedLRUCache carries a CPython/GIL safety argument; the ONE
+    place allowed to weigh it is utils.cache.make_lru."""
+
+    name = "unlocked-lru"
+
+    def run(self, module: ModuleSource) -> list[Violation]:
+        if module.path == "txflow_tpu/utils/cache.py":
+            return []
+        out: list[Violation] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                f = node.func
+                fname = _expr_str(f) if isinstance(f, (ast.Attribute, ast.Name)) else ""
+                if fname.rsplit(".", 1)[-1] == "UnlockedLRUCache":
+                    out.append(
+                        Violation(
+                            self.name, module.path, node.lineno,
+                            "direct UnlockedLRUCache(...) — construct via "
+                            "utils.cache.make_lru so the GIL check lives in "
+                            "one place",
+                        )
+                    )
+        return out

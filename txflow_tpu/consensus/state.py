@@ -32,6 +32,8 @@ import time
 from typing import Callable
 
 from ..state import BlockExecutor, State
+from ..analysis.lockgraph import make_rlock
+from ..utils.clock import now_ns
 from ..store.block_store import BlockStore
 from ..types.block import Block
 from ..types.block_vote import (
@@ -87,7 +89,7 @@ class ConsensusState:
 
         self.state = state  # last committed chain state
         self.rs = RoundState()
-        self._mtx = threading.RLock()
+        self._mtx = make_rlock("consensus.ConsensusState._mtx", allow_blocking=True)
         self._queue: queue.Queue = queue.Queue(maxsize=10000)
         self._running = False
         self._thread: threading.Thread | None = None
@@ -345,7 +347,7 @@ class ConsensusState:
             votes=HeightVoteSet(state.chain_id, height, state.validators),
             last_commit=last_commit,
             last_validators=state.last_validators.copy(),
-            start_time_ns=time.time_ns(),
+            start_time_ns=now_ns(),
         )
         self.rs.votes.set_round(0)
         # re-feed buffered votes that were early for the previous height and
@@ -483,7 +485,7 @@ class ConsensusState:
             pol_round = -1
         proposal = Proposal(
             height=height, round=round_, pol_round=pol_round,
-            block_hash=block.hash(), timestamp_ns=time.time_ns(),
+            block_hash=block.hash(), timestamp_ns=now_ns(),
         )
         try:
             self.priv_val.sign_proposal(self.state.chain_id, proposal)
@@ -632,7 +634,7 @@ class ConsensusState:
             return
         rs.step = RoundStep.COMMIT
         rs.commit_round = commit_round
-        rs.commit_time_ns = time.time_ns()
+        rs.commit_time_ns = now_ns()
         self._new_step()
         maj = rs.votes.precommits(commit_round).two_thirds_majority()
         assert maj, "enter_commit without precommit majority"

@@ -12,6 +12,8 @@ reference's mockTicker, consensus/common_test.go:698-741).
 from __future__ import annotations
 
 import threading
+
+from ..analysis.lockgraph import make_lock
 from dataclasses import dataclass
 from typing import Callable
 
@@ -27,7 +29,7 @@ class TimeoutInfo:
 class TimeoutTicker:
     def __init__(self, fire: Callable[[TimeoutInfo], None]):
         self._fire = fire
-        self._mtx = threading.Lock()
+        self._mtx = make_lock("consensus.Ticker._mtx")
         self._timer: threading.Timer | None = None
         self._pending: TimeoutInfo | None = None
         self._running = False
@@ -70,7 +72,7 @@ class ManualTicker:
 
     def __init__(self, fire: Callable[[TimeoutInfo], None]):
         self._fire = fire
-        self._mtx = threading.Lock()
+        self._mtx = make_lock("consensus.Ticker._mtx")
         self._pending: TimeoutInfo | None = None
 
     def start(self) -> None:

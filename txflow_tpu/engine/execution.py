@@ -13,6 +13,7 @@ import hashlib
 import time
 
 from ..abci.proxy import AppConnConsensus
+from ..analysis.lockgraph import sanctioned_blocking
 from ..pool.mempool import Mempool
 from ..utils import failpoints
 from ..utils.events import EventBus, EventDataTx, EventTx
@@ -78,12 +79,16 @@ class TxExecutor:
         """App Commit under the mempool lock (reference Commit :112-155)."""
         self.mempool.lock()
         try:
-            self.proxy_app.flush()
-            commit_res = self.proxy_app.commit_sync()
-            self.mempool.update(
-                height, [tx], [deliver_res],
-                keys=[tx_key] if tx_key is not None else None,
-            )
+            # holding the pool lock across the Commit fence IS the
+            # contract: no CheckTx may run against the app between Commit
+            # and mempool.update, or it validates against stale state
+            with sanctioned_blocking("app-Commit fence atomic with mempool.update"):
+                self.proxy_app.flush()
+                commit_res = self.proxy_app.commit_sync()
+                self.mempool.update(
+                    height, [tx], [deliver_res],
+                    keys=[tx_key] if tx_key is not None else None,
+                )
             return commit_res.data
         finally:
             self.mempool.unlock()
@@ -115,11 +120,14 @@ class TxExecutor:
 
         self.mempool.lock()
         try:
-            self.proxy_app.flush()
-            commit_res = self.proxy_app.commit_sync()
-            self.mempool.update(
-                height, [tx for tx, _ in items], results, keys=keys
-            )
+            # same contract as _commit: the fence and the pool update are
+            # one atomic step with respect to CheckTx
+            with sanctioned_blocking("app-Commit fence atomic with mempool.update"):
+                self.proxy_app.flush()
+                commit_res = self.proxy_app.commit_sync()
+                self.mempool.update(
+                    height, [tx for tx, _ in items], results, keys=keys
+                )
             app_hash = commit_res.data
         finally:
             self.mempool.unlock()

@@ -39,7 +39,8 @@ from ..pool.txvotepool import TxVotePool
 from ..store.tx_store import TxStore
 from ..types import TxVote, TxVoteSet
 from ..types.validator import ValidatorSet
-from ..utils.cache import LRUCache, UnlockedLRUCache
+from ..analysis.lockgraph import make_rlock
+from ..utils.cache import make_lru
 from ..utils.config import EngineConfig
 from ..utils.metrics import TxFlowMetrics
 from ..verifier import DeviceVoteVerifier, ReadyTicket, ScalarVoteVerifier
@@ -212,7 +213,7 @@ class TxFlow:
             getattr(self.verifier, "max_batch", self.config.max_batch),
         )
         self.vote_sets: dict[str, TxVoteSet] = {}  # in-flight only
-        self._committed = UnlockedLRUCache(1 << 16)  # recently committed tx hashes
+        self._committed = make_lru(1 << 16)  # recently committed tx hashes
         # ingest-log cursor: each pool entry is visited by step() exactly
         # once via the stable-cursor walk (in-batch repeats re-queue on
         # _retry). The previous skip-set drain re-walked EVERY live pool
@@ -220,7 +221,7 @@ class TxFlow:
         # instrumented profile).
         self._drain_cursor = 0
         self._retry: list[tuple[bytes, TxVote]] = []
-        self._mtx = threading.RLock()
+        self._mtx = make_rlock("engine.TxFlow._mtx")
         self._running = False
         self._thread: threading.Thread | None = None
         # commit pipeline (SURVEY §7 hard-part 5): quorum decisions flow to
@@ -804,6 +805,9 @@ class TxFlow:
         t0 = time.perf_counter()
         keys, votes = prep.keys, prep.votes
         requeued = 0
+        # inline-commit decisions made under _mtx; their store/ABCI
+        # side-effects run AFTER the lock is released (see below)
+        inline_commits: list[tuple[TxVoteSet, list[TxVote], bytes | None]] = []
         with self._mtx:
             self.metrics.batch_size.observe(len(votes))
             self.metrics.verified_votes.add(int(result.valid.sum()))
@@ -845,15 +849,27 @@ class TxFlow:
                         if self._committer is not None:
                             self._enqueue_commit(vs)
                         else:
-                            self._commit_tx(vs, purge_batch=purge_votes)
+                            # decision bookkeeping only — the effects
+                            # (save_tx fsync, ABCI apply round trip) must
+                            # not run under _mtx: they stalled every
+                            # try_add_vote/claim/stat reader behind disk
+                            # and socket (lock-blocking finding, fixed)
+                            inline_commits.append(self._decide_commit(vs))
                 else:
                     bad_keys.append(keys[i])  # dup/conflict: can never add
-            if purge_votes:
-                # one pool update per step (per-tx updates paid an O(log)
-                # bookkeeping walk per commit — r3 step profile: 0.9 ms each)
-                self.tx_vote_pool.update(self.height, purge_votes)
             if bad_keys:
                 self.tx_vote_pool.remove(bad_keys)
+
+        for vs, quorum_votes, tx in inline_commits:
+            # decision order preserved; _commit_effects re-acquires _mtx
+            # only to resolve deferred-apply ownership
+            self._commit_effects(
+                vs, quorum_votes, purge_votes, tx=tx, deferred=tx is None
+            )
+        if purge_votes:
+            # one pool update per step (per-tx updates paid an O(log)
+            # bookkeeping walk per commit — r3 step profile: 0.9 ms each)
+            self.tx_vote_pool.update(self.height, purge_votes)
 
         t1 = time.perf_counter()
         self._pipe_route_s += t1 - t0
@@ -913,7 +929,7 @@ class TxFlow:
 
     def try_add_vote(self, vote: TxVote) -> tuple[bool, Exception | None]:
         with self._mtx:
-            return self._add_vote_scalar(vote)
+            return self._add_vote_scalar(vote)  # txlint: allow(lock-blocking) -- golden scalar path: reference-exact synchronous commit semantics; serving traffic uses _route_result, whose effects run unlocked
 
     def _add_vote_scalar(self, vote: TxVote) -> tuple[bool, Exception | None]:
         """Reference-exact scalar path (used by tests as the golden engine)."""
@@ -931,6 +947,23 @@ class TxFlow:
         return added, err
 
     # ---- commit (reference addVote :216-232) ----
+
+    def _decide_commit(
+        self, vs: TxVoteSet
+    ) -> tuple[TxVoteSet, list[TxVote], bytes | None]:
+        """Locked half of an inline commit (pipeline_commits=False): the
+        same decision bookkeeping _enqueue_commit does for the committer
+        thread, but the effects run on THIS thread once _route_result
+        drops _mtx. The tx bytes and the _unapplied registration must
+        both happen here, atomically with the _committed mark — see
+        _enqueue_commit's comments for both races."""
+        quorum_votes = vs.get_votes()
+        self.vote_sets.pop(vs.tx_hash, None)
+        self._committed.push(_hash_key(vs.tx_hash))
+        tx = self.mempool.get_tx(vs.tx_key)
+        if tx is None:
+            self._unapplied[vs.tx_hash] = vs.tx_key
+        return vs, quorum_votes, tx
 
     def _commit_tx(self, vs: TxVoteSet, purge_batch: list | None = None) -> None:
         """Inline commit (scalar golden path / pipeline_commits=False)."""
@@ -968,16 +1001,30 @@ class TxFlow:
         quorum_votes: list[TxVote],
         purge_batch: list | None,
         tx: bytes | None = None,
+        deferred: bool = False,
     ) -> None:
         """Store + execute + commitpool effects (reference addVote
-        :216-232 sequence); runs on the committer thread when pipelined."""
+        :216-232 sequence). Runs under _mtx only on the scalar golden
+        path (_commit_tx); _route_result's inline path calls it unlocked.
+
+        deferred=True means the tx bytes were absent at DECISION time and
+        an _unapplied entry was registered under _mtx (_decide_commit) —
+        by now the block path (claim_vtx) may own the delivery, or the
+        bytes may have arrived: resolve ownership under _mtx exactly like
+        _commit_batch does, and never apply twice."""
         self.tx_store.save_tx(vs, votes=quorum_votes)
         if tx is None:
-            tx = self.mempool.get_tx(vs.tx_key)
-        if tx is None:
-            # bytes not here yet: defer (see _unapplied in __init__)
             with self._mtx:
-                self._unapplied[vs.tx_hash] = vs.tx_key
+                if deferred and vs.tx_hash not in self._unapplied:
+                    pass  # claim_vtx handed the delivery to a block
+                else:
+                    tx = self.mempool.get_tx(vs.tx_key)
+                    if tx is None:
+                        # bytes not here yet: defer (see _unapplied in
+                        # __init__); no-op re-registration when deferred
+                        self._unapplied[vs.tx_hash] = vs.tx_key
+                    elif deferred:
+                        del self._unapplied[vs.tx_hash]
         if tx is not None:
             # the hash handed to events/indexer must describe the tx actually
             # fetched and applied: tx came from mempool.get_tx(vs.tx_key), and
@@ -1247,7 +1294,7 @@ class TxFlow:
             # durable marker: the in-memory LRU can evict, and a tx that
             # committed only via a block has no TxStore certificate —
             # is_tx_committed must never regress to False for it
-            self.tx_store.mark_block_committed(tx_hash)
+            self.tx_store.mark_block_committed(tx_hash)  # txlint: allow(lock-blocking) -- claim must be atomic with the commit decision (r3 app-hash fork); marker is one buffered db put, no fsync on this path
             if vs is not None:
                 # release the set's aggregated votes from the pool — the
                 # drain cursor has passed them and no engine commit will

@@ -14,13 +14,15 @@ from __future__ import annotations
 
 import threading
 
+from ..analysis.lockgraph import make_lock
+
 from ..utils.metrics import HealthMetrics, Registry
 
 
 class DegradedModeRegistry:
     def __init__(self, metrics_registry: Registry):
         self.metrics = HealthMetrics(metrics_registry)
-        self._mtx = threading.Lock()
+        self._mtx = make_lock("health.DegradedModeRegistry._mtx")
         # event totals (watchdog + peer scorer hooks)
         self.watchdog_firings = 0
         self.watchdog_escalations = 0

@@ -28,6 +28,8 @@ import socket
 import struct
 import threading
 
+from ..analysis.lockgraph import make_lock
+
 from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 
 from ..crypto import ed25519, x25519
@@ -65,7 +67,7 @@ class SecretConnection:
 
     def __init__(self, sock: socket.socket, node_seed: bytes, label: str = ""):
         self._sock = sock
-        self._wlock = threading.Lock()
+        self._wlock = make_lock("p2p.SecretConnection._wlock", allow_blocking=True)
         self._closed = threading.Event()
         self.label = label
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -145,7 +147,7 @@ class SecretConnection:
                 self._nonce(self._send_ctr), bytes([chan_id]) + msg, b""
             )
             self._send_ctr += 1
-            self._sock.sendall(_LEN.pack(len(ct)) + ct)
+            self._sock.sendall(_LEN.pack(len(ct)) + ct)  # txlint: allow(lock-blocking) -- _wlock EXISTS to serialize frame writes; nonce counter and wire bytes must advance together
 
     def _recv_frame(self, timeout: float | None = None) -> tuple[int, bytes]:
         prev = self._sock.gettimeout()

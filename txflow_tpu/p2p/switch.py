@@ -16,6 +16,8 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+
+from ..analysis.lockgraph import make_rlock
 import time
 
 from .base import ChannelDescriptor, Reactor
@@ -200,7 +202,7 @@ class Switch:
         self._chan_to_reactor: dict[int, Reactor] = {}
         self._channels: dict[int, ChannelDescriptor] = {}
         self._peers: dict[str, Peer] = {}
-        self._mtx = threading.RLock()
+        self._mtx = make_rlock("p2p.Switch._mtx")
         self._running = False
         self._fault_injector = None
 

@@ -20,6 +20,8 @@ import socket
 import struct
 import threading
 
+from ..analysis.lockgraph import make_lock, note_blocking
+
 _FRAME_HDR = struct.Struct("!BI")
 
 # Hard cap on one frame; matches the reference's 1 MiB gossip message cap
@@ -111,7 +113,7 @@ class TCPConnection:
     def __init__(self, sock: socket.socket, label: str = ""):
         self._sock = sock
         self._rfile = sock.makefile("rb")
-        self._wlock = threading.Lock()
+        self._wlock = make_lock("p2p.TCPConnection._wlock", allow_blocking=True)
         self._closed = threading.Event()
         self.label = label
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -122,9 +124,12 @@ class TCPConnection:
         if len(msg) > MAX_FRAME_BYTES:
             raise ValueError(f"frame too large: {len(msg)}")
         frame = _FRAME_HDR.pack(chan_id, len(msg)) + msg
+        # a peer that stops reading can stall sendall for the socket
+        # timeout: callers must not hold shared node locks into send()
+        note_blocking("p2p.socket-send")
         try:
             with self._wlock:
-                self._sock.sendall(frame)
+                self._sock.sendall(frame)  # txlint: allow(lock-blocking) -- _wlock EXISTS to serialize whole-frame writes; interleaved sendall would corrupt the stream
             return True
         except OSError:
             self.close()

@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import threading
 
+from ..analysis.lockgraph import make_rlock
+
 # Compact when at least this many dead entries can be dropped at once.
 COMPACT_THRESHOLD = 4096
 
@@ -31,7 +33,7 @@ class IngestLogPool:
     accept / ``_log_compact`` after bulk removals, all under ``self._mtx``."""
 
     def __init__(self):
-        self._mtx = threading.RLock()
+        self._mtx = make_rlock(f"pool.{type(self).__name__}._mtx")
         self._cond = threading.Condition(self._mtx)
         self._seq = 0
         self._log: list[bytes] = []

@@ -17,7 +17,7 @@ import threading
 from dataclasses import dataclass, field
 
 from ..crypto.hash import sha256
-from ..utils.cache import LRUCache, NopCache, UnlockedLRUCache
+from ..utils.cache import make_lru
 from ..utils.config import MempoolConfig
 from ..utils.wal import WAL
 from .base import IngestLogPool
@@ -82,7 +82,7 @@ class Mempool(IngestLogPool):
         self.post_check = post_check
         self._txs: dict[bytes, _MempoolTx] = self._items  # tx_key -> entry
         self._txs_bytes = 0
-        self.cache = UnlockedLRUCache(config.cache_size) if config.cache_size > 0 else NopCache()
+        self.cache = make_lru(config.cache_size)
         self._txs_available = threading.Event()
         self._notified_txs_available = False
         self._notify_available = False
@@ -121,10 +121,36 @@ class Mempool(IngestLogPool):
 
         key: sha256(tx) when the caller already has it (the commit path
         always does — vs.tx_key IS the mempool key), skipping a per-push
-        hash (r4 profile)."""
+        hash (r4 profile).
+
+        A socket-backed app conn is the exception path: its CheckTx is a
+        round trip, and holding the pool lock across it stalled every
+        reader (reap/drain/update/size) behind the socket — the
+        lock-discipline finding this split fixes. The round trip runs
+        UNLOCKED between an admission phase (caps + dedup-cache push,
+        which reserves the key) and an insert phase that re-checks caps.
+        A concurrent dup during the app call sees the cache reservation
+        and gets ErrTxInCache, same verdict as the serialized path."""
         tx_info = tx_info or TxInfo()
+        app = self.proxy_app
+        if app is None or getattr(app, "is_local", False):
+            with self._mtx:
+                self._check_tx_locked(tx, tx_info, write_wal, key)
+            return
+        if key is None:
+            key = sha256(tx)
         with self._mtx:
-            self._check_tx_locked(tx, tx_info, write_wal, key)
+            self._admit_locked(tx, tx_info, key)
+        try:
+            res = app.check_tx_sync(tx)
+        except BaseException:
+            self.cache.remove(key)  # allow a retry after a conn failure
+            raise
+        if not res.is_ok:
+            self.cache.remove(key)
+            raise ValueError(f"rejected by app CheckTx (code {res.code}): {res.log}")
+        with self._mtx:
+            self._insert_checked_locked(tx, tx_info, write_wal, key, res)
 
     def check_tx_many(
         self,
@@ -176,14 +202,11 @@ class Mempool(IngestLogPool):
                     self._notify_txs_available()
         return out
 
-    def _check_tx_locked(
-        self,
-        tx: bytes,
-        tx_info: TxInfo,
-        write_wal: bool = True,
-        key: bytes | None = None,
-        notify: bool = True,
-    ) -> None:
+    def _admit_locked(self, tx: bytes, tx_info: TxInfo, key: bytes) -> None:
+        """Admission phase: caps, dedup-cache reservation, pre_check.
+        Raises on rejection; on success the key is RESERVED in the cache
+        (dups now answer ErrTxInCache) and the caller owes either an
+        insert or a cache.remove rollback."""
         if (
             len(self._txs) >= self.config.size
             or len(tx) + self._txs_bytes > self.config.max_txs_bytes
@@ -191,8 +214,6 @@ class Mempool(IngestLogPool):
             raise ErrMempoolIsFull(
                 len(self._txs), self.config.size, self._txs_bytes, self.config.max_txs_bytes
             )
-        if key is None:
-            key = sha256(tx)
         if not self.cache.push(key):
             entry = self._txs.get(key)
             if entry is not None:
@@ -203,23 +224,36 @@ class Mempool(IngestLogPool):
             if err is not None:
                 self.cache.remove(key)
                 raise ValueError(f"rejected by pre_check: {err}")
-        fast_path = True
-        if self.proxy_app is not None:
-            res = self.proxy_app.check_tx_sync(tx)
-            if not res.is_ok:
-                self.cache.remove(key)
-                raise ValueError(f"rejected by app CheckTx (code {res.code}): {res.log}")
-            gas = res.gas_wanted
-            fast_path = getattr(res, "fast_path", True)
-        else:
-            gas = 0
+
+    def _insert_checked_locked(
+        self,
+        tx: bytes,
+        tx_info: TxInfo,
+        write_wal: bool,
+        key: bytes,
+        res,
+        notify: bool = True,
+    ) -> None:
+        """Insert phase: post_check, WAL, pool entry, notify. res is the
+        app CheckTx response (None = no app). Re-checks caps — the
+        unlocked app round trip may have let the pool fill."""
+        if (
+            len(self._txs) >= self.config.size
+            or len(tx) + self._txs_bytes > self.config.max_txs_bytes
+        ):
+            self.cache.remove(key)
+            raise ErrMempoolIsFull(
+                len(self._txs), self.config.size, self._txs_bytes, self.config.max_txs_bytes
+            )
         if self.post_check is not None:
             err = self.post_check(tx)
             if err is not None:
                 self.cache.remove(key)
                 raise ValueError(f"rejected by post_check: {err}")
         if self.wal is not None and write_wal:
-            self.wal.write(tx)
+            self.wal.write(tx)  # txlint: allow(lock-blocking) -- WAL append order must match insertion order; buffered write, fsync only if sync_on_write
+        gas = res.gas_wanted if res is not None else 0
+        fast_path = getattr(res, "fast_path", True) if res is not None else True
         entry = _MempoolTx(
             self.height, gas, tx, {tx_info.sender_id}, fast_path
         )
@@ -231,6 +265,32 @@ class Mempool(IngestLogPool):
         self._txs_bytes += len(tx)
         if notify:
             self._notify_txs_available()
+
+    def _check_tx_locked(
+        self,
+        tx: bytes,
+        tx_info: TxInfo,
+        write_wal: bool = True,
+        key: bytes | None = None,
+        notify: bool = True,
+    ) -> None:
+        """Single-lock-hold ingest: only valid when the app conn is local
+        (in-process) or absent — check_tx/check_tx_many gate on is_local
+        before entering this under the pool lock."""
+        if key is None:
+            key = sha256(tx)
+        self._admit_locked(tx, tx_info, key)
+        res = None
+        if self.proxy_app is not None:
+            try:
+                res = self.proxy_app.check_tx_sync(tx)  # txlint: allow(lock-blocking) -- local in-process app only (is_local gated): microseconds, no socket
+            except BaseException:
+                self.cache.remove(key)
+                raise
+            if not res.is_ok:
+                self.cache.remove(key)
+                raise ValueError(f"rejected by app CheckTx (code {res.code}): {res.log}")
+        self._insert_checked_locked(tx, tx_info, write_wal, key, res, notify)
 
     def _notify_txs_available(self) -> None:
         if self._notify_available and not self._notified_txs_available:

@@ -25,7 +25,7 @@ from dataclasses import dataclass, field
 from ..codec import amino
 from ..crypto.hash import sha256
 from ..types import TxVote, decode_tx_vote, encode_tx_vote
-from ..utils.cache import LRUCache, NopCache, UnlockedLRUCache
+from ..utils.cache import make_lru
 from ..utils.config import MempoolConfig
 from ..utils.wal import WAL
 from .base import IngestLogPool
@@ -69,7 +69,7 @@ class TxVotePool(IngestLogPool):
         # the inlined check_tx_many twin) and every removal path.
         self._by_tx: dict[str, dict[bytes, None]] = {}
         self._votes_bytes = 0
-        self.cache = UnlockedLRUCache(config.cache_size) if config.cache_size > 0 else NopCache()
+        self.cache = make_lru(config.cache_size)
         self._txs_available = threading.Event()
         self._notified_txs_available = False
         self._notify_available = False
@@ -239,7 +239,7 @@ class TxVotePool(IngestLogPool):
                         out[i] = ErrTxInCache()
                         continue
                     if wal is not None:
-                        wal.write(encoded)
+                        wal.write(encoded)  # txlint: allow(lock-blocking) -- WAL append order must match ingest-log order; buffered write, fsync only if sync_on_write
                     seg = vote._seg_cache
                     if seg is None:
                         seg = amino.length_prefixed(encoded)
@@ -293,7 +293,7 @@ class TxVotePool(IngestLogPool):
                 entry.senders.add(tx_info.sender_id)
             raise ErrTxInCache()
         if self.wal is not None and write_wal:
-            self.wal.write(encoded)
+            self.wal.write(encoded)  # txlint: allow(lock-blocking) -- WAL append order must match ingest-log order; buffered write, fsync only if sync_on_write
         seg = vote._seg_cache
         if seg is None:
             seg = amino.length_prefixed(encoded)

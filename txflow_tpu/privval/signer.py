@@ -23,6 +23,8 @@ import socket
 import struct
 import threading
 
+from ..analysis.lockgraph import make_lock
+
 from ..types.tx_vote import TxVote
 from .file import ErrDoubleSign
 
@@ -159,7 +161,7 @@ class SignerClient:
     def __init__(self, host: str, port: int, timeout: float = 5.0):
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.settimeout(timeout)
-        self._mtx = threading.Lock()
+        self._mtx = make_lock("privval.SignerClient._mtx", allow_blocking=True)
         resp = self._call({"type": "pubkey_request"})
         self._pub_key = bytes.fromhex(resp["pub_key"])
 

@@ -10,6 +10,8 @@ reference (:137); only undecodable frames stop the peer.
 from __future__ import annotations
 
 import threading
+
+from ..analysis.lockgraph import make_lock
 import time
 
 from ..codec import amino
@@ -58,7 +60,7 @@ class MempoolReactor(Reactor):
         self._running = threading.Event()
         self._peer_ids: dict[str, int] = {}
         self._next_peer_id = 1
-        self._ids_mtx = threading.Lock()
+        self._ids_mtx = make_lock("reactors.MempoolReactor._ids_mtx")
         self._threads: list[threading.Thread] = []
 
     def get_channels(self) -> list[ChannelDescriptor]:

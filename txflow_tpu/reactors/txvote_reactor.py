@@ -21,6 +21,8 @@ for the consensus reactor's PeerState that the reference reads at :233).
 from __future__ import annotations
 
 import threading
+
+from ..analysis.lockgraph import make_lock
 import time
 from dataclasses import dataclass
 from typing import Callable
@@ -95,7 +97,7 @@ class TxVoteReactor(Reactor):
         self._running = threading.Event()
         self._peer_ids: dict[str, int] = {}  # node_id -> small int (txVotePoolIDs)
         self._next_peer_id = 1
-        self._ids_mtx = threading.Lock()
+        self._ids_mtx = make_lock("reactors.TxVoteReactor._ids_mtx")
         self._threads: list[threading.Thread] = []
         self._sign_thread: threading.Thread | None = None
         # wire-segment dedup + decoded-vote sharing: raw segment bytes ->

@@ -29,6 +29,11 @@ def _gil_enabled() -> bool:
         return True
 
 
+# the GIL is a property of the build + interpreter launch options, not of
+# any one call site: weigh it once
+_GIL_ENABLED = _gil_enabled()
+
+
 class LRUCache:
     def __init__(self, size: int):
         if size <= 0:
@@ -89,7 +94,7 @@ class UnlockedLRUCache:
     provides it."""
 
     def __new__(cls, size: int):
-        if not _gil_enabled():
+        if not _GIL_ENABLED:
             return LRUCache(size)
         return object.__new__(cls)
 
@@ -121,6 +126,23 @@ class UnlockedLRUCache:
 
     def __len__(self) -> int:
         return len(self._map)
+
+
+def make_lru(size: int):
+    """The one construction seam for dedup caches — and the ONE place
+    that weighs the CPython/GIL safety argument (checked once at import,
+    module constant below). txlint's ``unlocked-lru`` rule forbids
+    constructing UnlockedLRUCache directly anywhere else.
+
+    size <= 0 means "cache disabled" (NopCache), matching the pools'
+    config.cache_size contract. On GIL builds the owner-serialized
+    lock-free cache is returned; on free-threaded builds every caller
+    transparently gets the locked LRUCache instead."""
+    if size <= 0:
+        return NopCache()
+    if _GIL_ENABLED:
+        return UnlockedLRUCache(size)
+    return LRUCache(size)
 
 
 class NopCache:

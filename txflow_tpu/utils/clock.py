@@ -1,0 +1,28 @@
+"""Wall-clock seam for consensus-critical modules.
+
+txlint's ``nondeterminism`` rule forbids raw ``time.time()`` /
+``time.time_ns()`` (and unseeded rng) inside certificate- and
+consensus-critical modules (types/vote_set, engine/txflow, consensus/*):
+a timestamp read mid-decision is a per-node value that lands in signed
+artifacts (proposal timestamps) and replay logs, and scattering direct
+clock reads makes "pin the clock" impossible in tests and replays.
+
+This module is the one sanctioned source: consensus code imports
+``now_ns``/``now`` from here, tests monkeypatch here, and the lint pass
+whitelists calls routed through these names. Keep it free of any other
+dependency — it is imported by the lowest layers.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def now_ns() -> int:
+    """Wall-clock nanoseconds (proposal timestamps, commit times)."""
+    return time.time_ns()
+
+
+def now() -> float:
+    """Wall-clock seconds."""
+    return time.time()

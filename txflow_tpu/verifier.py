@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from .analysis.lockgraph import make_lock, note_blocking
 from .crypto import ed25519 as host_ed
 from .ops import ed25519_batch, tally
 from .types.validator import ValidatorSet
@@ -81,7 +82,7 @@ class VerifyCache:
 
         self.capacity = capacity
         self.claim_ttl = claim_ttl
-        self._mtx = threading.Lock()
+        self._mtx = make_lock("verifier.VerifyCache._mtx")
         self._d: OrderedDict[bytes, bool] = OrderedDict()
         # in-flight claims: key -> monotonic claim time. Without claims,
         # co-located engines that miss on the SAME votes all ship them to
@@ -281,6 +282,7 @@ class _FusedDeviceTicket(VerifyTicket):
     def result(self) -> TallyResult:
         if self._done is not None:
             return self._done
+        note_blocking("verifier.device-readback")
         packed = np.asarray(self._packed)  # the ONE blocking readback
         self._packed = None
         rows = packed.reshape(self._n_shards, -1)
@@ -336,6 +338,7 @@ class _CachedDeviceTicket(VerifyTicket):
         # readback can start arbitrarily long after dispatch (pipelined
         # engine) and a missed keepalive beat must not cost ownership
         self._cache.heartbeat_many(self._miss_keys)
+        note_blocking("verifier.device-readback")
         try:
             packed = np.asarray(self._packed)  # blocking readback
         except BaseException:
@@ -972,7 +975,7 @@ class ResilientVoteVerifier:
         self.probe_interval = probe_interval
         self._sleep = sleep
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("verifier.ResilientVoteVerifier._lock")
         self._device_ok = True
         self._next_probe = 0.0
         # observability (bench/RPC surface them; tests assert transitions)
@@ -1192,7 +1195,7 @@ class VerifierMux:
         self._running = False
         self._thread: _t.Thread | None = None
         self._collector: _t.Thread | None = None
-        self._lock = _t.Lock()
+        self._lock = make_lock("verifier.VerifierMux._lock")
         # dispatcher generation: a dispatcher that outlives its stop() (a
         # long device batch ran past the join timeout) exits on its own at
         # the next loop turn instead of racing a restarted dispatcher for
