@@ -118,6 +118,9 @@ def test_pex_mesh_stable_with_secret_connections():
                     use_device_verifier=False,
                     enable_consensus=False,
                     node_key_seed=hashlib.sha256(b"spex-key-%d" % i).digest(),
+                    # this test wires its OWN book/reactor below; a keyed
+                    # node would otherwise auto-register PEX (ch 0x00)
+                    pex=False,
                 ),
             )
             nodes.append(n)

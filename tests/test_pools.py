@@ -330,6 +330,43 @@ def test_votepool_check_tx_many_parity():
         assert not a.has(vote_key(v)) and not b.has(vote_key(v))
 
 
+def test_votepool_lane_eviction_parity():
+    """Lane-aware ingest through both twins: priority votes land on the
+    priority log, and at pool-full a priority vote evicts the oldest
+    bulk vote while a bulk vote still bounces — identically via check_tx
+    and check_tx_many (drift alarm for the lane/eviction branch)."""
+    from txflow_tpu.pool.mempool import LANE_BULK, LANE_PRIORITY
+
+    pv = MockPV()
+    bulk = [make_vote(i, pv) for i in range(3)]
+    prio = make_vote(50, pv)
+    bulk_late = make_vote(51, pv)
+    prio_keys = {prio.tx_key}
+
+    def mk():
+        p = TxVotePool(MempoolConfig(size=3, cache_size=100))
+        p.lane_of_vote = lambda v: (
+            LANE_PRIORITY if v.tx_key in prio_keys else LANE_BULK
+        )
+        return p
+
+    seq = bulk + [bulk_late, prio]  # full -> bulk bounces, priority evicts
+    a, b = mk(), mk()
+    errs_one = _drive_one_by_one(a.check_tx, seq)
+    errs_many = b.check_tx_many(seq)
+    assert [type(e) for e in errs_one] == [type(e) for e in errs_many]
+    assert [type(e) for e in errs_many] == [
+        type(None), type(None), type(None), ErrMempoolIsFull, type(None),
+    ]
+    for p in (a, b):
+        assert p.size() == 3
+        assert p.has(vote_key(prio))
+        assert not p.has(vote_key(bulk[0]))  # oldest bulk vote evicted
+        assert not p.in_cache(vote_key(bulk[0]))  # re-deliverable
+        items, _ = p.priority_entries_from(0, limit=10)
+        assert [k for k, _v, _h, _s in items] == [vote_key(prio)]
+
+
 def test_mempool_check_tx_many_parity():
     """Mempool twin of the votepool parity test: dup, byte-budget full,
     pre_check rejection, and size-cap full must come out of check_tx and

@@ -1,0 +1,31 @@
+"""Admission control: the node's front door (ROADMAP "survive a
+million-user ingress").
+
+Sits between the RPC/gossip edges and the mempool. Three duties:
+
+- **edge dedup**: replayed tx bytes are rejected at the edge, before any
+  signature work or app CheckTx round trip;
+- **overload backpressure**: pool high-water marks (with hysteresis)
+  propagate to the RPC server (HTTP 429 + Retry-After) and to the
+  mempool reactor (bulk ingest gossip pauses/sheds — vote gossip never
+  does, quorums must keep forming for what was admitted);
+- **fee/priority lanes**: a deterministic classifier (fee-prefix by
+  default) splits txs into a priority lane that keeps committing at
+  flat p50 under overload and a best-effort bulk lane that sheds.
+
+Every rejection is surfaced via ``txflow_admission_*`` metrics — never a
+silent drop.
+"""
+
+from .config import AdmissionConfig
+from .classifier import FeeLaneClassifier, parse_fee
+from .controller import AdmissionController, ErrDuplicateTx, ErrOverloaded
+
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "ErrDuplicateTx",
+    "ErrOverloaded",
+    "FeeLaneClassifier",
+    "parse_fee",
+]

@@ -1,0 +1,47 @@
+"""Fee-prefix lane classifier.
+
+Lanes must be a *deterministic function of the tx bytes*: every honest
+node classifies a gossiped tx identically with no coordination, so the
+priority lane behaves the same at every edge. The default convention is a
+self-describing prefix on the tx bytes themselves —
+
+    b"fee=<n>;<payload>"
+
+— n >= the configured threshold rides the priority lane; anything else
+(no prefix, malformed, below threshold) is best-effort bulk. A node
+assembly can swap in any other ``tx -> lane`` callable via
+``NodeConfig.lane_classifier`` (e.g. stake-weighted per "Weighted Voting
+on the Blockchain", arxiv 1903.04213) as long as it stays deterministic.
+"""
+
+from __future__ import annotations
+
+from ..pool.mempool import LANE_BULK, LANE_PRIORITY
+
+# the fee prefix is a handful of digits; bound the scan so a hostile
+# "fee="-prefixed megabyte tx costs O(1) to classify
+_FEE_SCAN_LIMIT = 24
+
+
+def parse_fee(tx: bytes) -> int:
+    """Fee declared by the tx's ``fee=<n>;`` prefix; 0 when absent or
+    malformed (malformed never errors — it just rides the bulk lane)."""
+    if not tx.startswith(b"fee="):
+        return 0
+    end = tx.find(b";", 4, _FEE_SCAN_LIMIT)
+    if end < 0:
+        return 0
+    try:
+        return int(tx[4:end])
+    except ValueError:
+        return 0
+
+
+class FeeLaneClassifier:
+    """tx -> lane via the fee prefix (the default NodeConfig classifier)."""
+
+    def __init__(self, priority_fee_threshold: int = 1):
+        self.threshold = priority_fee_threshold
+
+    def __call__(self, tx: bytes) -> int:
+        return LANE_PRIORITY if parse_fee(tx) >= self.threshold else LANE_BULK

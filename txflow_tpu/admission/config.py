@@ -1,0 +1,54 @@
+"""AdmissionConfig: front-door tunables (see controller.py for semantics).
+
+One dataclass so a node assembly, a soak rig, or a test can swap the whole
+overload posture at once — the HealthConfig pattern.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class AdmissionConfig:
+    enabled: bool = True
+
+    # -- overload hysteresis (fractions of config.mempool.size) --
+    # pool occupancy at/above high_water flips the node into overload;
+    # it stays overloaded until occupancy falls back to low_water. The
+    # gap prevents flapping right at the mark (admit one tx -> overloaded
+    # -> shed one -> healthy -> ...), which would thrash the gossip pause
+    high_water_frac: float = 0.85
+    low_water_frac: float = 0.60
+    # bulk-lane headroom: even below high water, best-effort txs may not
+    # fill the pool past this fraction — the reserve above it belongs to
+    # the priority lane, so a bulk flood can never squeeze priority
+    # admissions out of the pool entirely
+    bulk_headroom_frac: float = 0.70
+
+    # Retry-After seconds handed to 429'd clients
+    retry_after: float = 1.0
+
+    # edge dedup LRU (replayed tx bytes rejected before signature work);
+    # sized above the mempool dedup cache so the edge absorbs replays the
+    # pool cache has already rotated out
+    dedup_size: int = 65536
+
+    # fee-prefix lane classifier: txs carrying b"fee=<n>;" with
+    # n >= this threshold ride the priority lane (classifier.py)
+    priority_fee_threshold: int = 1
+
+    # occupancy poll cadence: the overload verdict is cached this long so
+    # the admit path costs O(1) between polls (no pool lock per request)
+    pressure_interval: float = 0.05
+
+    # bulk admit-rate cap (token bucket, tx/s; 0 disables). Occupancy
+    # watermarks alone admit bulk until buffers FILL — classic
+    # bufferbloat: the pool then runs at headroom depth and every queue
+    # behind it (vote pool, verify engine) saturates, which taxes the
+    # priority lane's latency even though it never queues. Capping the
+    # bulk ADMIT RATE below pipeline capacity keeps the system inside
+    # its latency headroom while the flood sheds with 429 + Retry-After.
+    bulk_rate: float = 0.0
+    # token-bucket burst depth (tx); 0 = one second's worth of bulk_rate
+    bulk_burst: float = 0.0

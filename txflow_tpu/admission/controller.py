@@ -1,0 +1,193 @@
+"""AdmissionController: edge dedup + overload backpressure + lane
+classification, in front of the mempool.
+
+Decision order on the RPC path (``admit_rpc``) is load-bearing:
+
+1. edge dedup **membership check** (no reservation yet) — replayed bytes
+   are rejected before anything else, matching the mempool-cache verdict
+   a non-replay would eventually get, so the two dup paths answer the
+   same thing;
+2. lane classification (deterministic from tx bytes — classifier.py);
+3. overload / bulk-headroom shed for the bulk lane (429 upstream);
+4. only now the key is *pushed* into the edge dedup and the admission
+   counted. Pushing before step 3 would poison the client's retry: an
+   overload-rejected tx would read as a "duplicate" when resubmitted
+   after Retry-After.
+
+The caller owes ``forget(key)`` if the mempool then rejects the tx for
+any reason other than its own dup cache (full pool, app rejection, conn
+failure) — otherwise legitimate retries would bounce off the edge.
+
+The admit path is called from every RPC handler thread and the gossip
+receive path: it must never block (txlint pins this — the admission
+functions are in the hotpath-sync no-block set). The pool-occupancy poll
+is therefore cached for ``pressure_interval``; between polls the verdict
+is O(1) cache/counter work.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..analysis.lockgraph import make_lock
+from ..pool.mempool import LANE_BULK, LANE_PRIORITY
+from ..utils.cache import make_lru
+from ..utils.metrics import AdmissionMetrics
+from .classifier import FeeLaneClassifier
+from .config import AdmissionConfig
+
+
+class ErrDuplicateTx(Exception):
+    """Replayed tx bytes caught by the edge dedup (before signatures)."""
+
+
+class ErrOverloaded(Exception):
+    """Bulk-lane tx shed under overload; retry after ``retry_after`` s."""
+
+    def __init__(self, retry_after: float):
+        super().__init__(f"node overloaded; retry after {retry_after}s")
+        self.retry_after = retry_after
+
+
+class AdmissionController:
+    def __init__(
+        self,
+        mempool,
+        cfg: AdmissionConfig | None = None,
+        registry=None,
+        classifier=None,
+    ):
+        self.mempool = mempool
+        self.cfg = cfg or AdmissionConfig()
+        self.classifier = classifier or FeeLaneClassifier(
+            self.cfg.priority_fee_threshold
+        )
+        self.metrics = AdmissionMetrics(registry)
+        # serializes edge-dedup mutations + the cached overload verdict
+        # (make_lru returns the owner-serialized cache on GIL builds; this
+        # lock IS that owner)
+        self._mtx = make_lock("admission.AdmissionController._mtx")
+        self.dedup = make_lru(self.cfg.dedup_size)
+        self._overloaded = False
+        self._next_poll = 0.0  # monotonic deadline of the cached verdict
+        # bulk admit-rate token bucket (cfg.bulk_rate; see config.py) —
+        # refilled lazily on each verdict, state guarded by _mtx
+        self._bulk_tokens = max(self.cfg.bulk_burst, self.cfg.bulk_rate, 1.0)
+        self._bulk_refill_t: float | None = None
+
+    # -- lane classification (mempool.lane_of hook) --
+
+    def lane_of(self, tx: bytes) -> int:
+        """Lane for a tx entering the pool by ANY path (RPC, gossip,
+        direct check_tx). Classifier faults demote to bulk — a hostile tx
+        must not be able to error the insert path."""
+        try:
+            lane = self.classifier(tx)
+        except Exception:
+            return LANE_BULK
+        return LANE_PRIORITY if lane == LANE_PRIORITY else LANE_BULK
+
+    # -- overload verdict --
+
+    def overloaded(self, now: float | None = None) -> bool:
+        """Hysteresis over pool occupancy: flips on at high_water_frac,
+        off at low_water_frac; verdict cached for pressure_interval."""
+        if not self.cfg.enabled:
+            return False
+        if now is None:
+            now = time.monotonic()
+        with self._mtx:
+            if now < self._next_poll:
+                return self._overloaded
+            self._next_poll = now + self.cfg.pressure_interval
+        occ = self.mempool.size() / max(1, self.mempool.config.size)
+        with self._mtx:
+            if self._overloaded:
+                if occ <= self.cfg.low_water_frac:
+                    self._overloaded = False
+            elif occ >= self.cfg.high_water_frac:
+                self._overloaded = True
+            over = self._overloaded
+        self.metrics.occupancy.set(occ)
+        self.metrics.overloaded.set(1.0 if over else 0.0)
+        return over
+
+    def _bulk_rate_exceeded(self, now: float | None = None) -> bool:
+        """Token-bucket verdict for ONE bulk admission (consumes a token
+        on pass). Disabled when cfg.bulk_rate == 0."""
+        rate = self.cfg.bulk_rate
+        if rate <= 0:
+            return False
+        if now is None:
+            now = time.monotonic()
+        cap = max(self.cfg.bulk_burst, rate, 1.0)
+        with self._mtx:
+            if self._bulk_refill_t is not None and now > self._bulk_refill_t:
+                self._bulk_tokens = min(
+                    cap, self._bulk_tokens + (now - self._bulk_refill_t) * rate
+                )
+            self._bulk_refill_t = now
+            if self._bulk_tokens >= 1.0:
+                self._bulk_tokens -= 1.0
+                return False
+            return True
+
+    def _bulk_shed(self, now: float | None = None) -> bool:
+        """Should a bulk-lane tx be shed right now? Overload, the bulk
+        lane alone crowding past its headroom fraction of the pool, or
+        the bulk admit-rate bucket running dry."""
+        if self.overloaded(now):
+            return True
+        bulk = self.mempool.lane_size(LANE_BULK)
+        if bulk >= self.cfg.bulk_headroom_frac * max(1, self.mempool.config.size):
+            return True
+        return self._bulk_rate_exceeded(now)
+
+    # -- RPC edge --
+
+    def admit_rpc(self, tx: bytes, key: bytes, now: float | None = None) -> int:
+        """Admit a client-submitted tx (key = sha256(tx)); returns its
+        lane. Raises ErrDuplicateTx / ErrOverloaded (see module doc for
+        the ordering contract)."""
+        if not self.cfg.enabled:
+            return self.lane_of(tx)
+        with self._mtx:
+            dup = key in self.dedup
+        if dup:
+            self.metrics.rejected_dup.add(1)
+            raise ErrDuplicateTx(f"tx {key.hex()[:16]} replayed at the edge")
+        lane = self.lane_of(tx)
+        if lane != LANE_PRIORITY and self._bulk_shed(now):
+            self.metrics.rejected_overload.add(1)
+            raise ErrOverloaded(self.cfg.retry_after)
+        with self._mtx:
+            self.dedup.push(key)
+        if lane == LANE_PRIORITY:
+            self.metrics.admitted_priority.add(1)
+        else:
+            self.metrics.admitted_bulk.add(1)
+        return lane
+
+    def forget(self, key: bytes) -> None:
+        """Roll an admit_rpc reservation back (mempool rejected the tx
+        for a non-dup reason) so the client's retry isn't dup-bounced."""
+        with self._mtx:
+            self.dedup.remove(key)
+
+    # -- gossip edge --
+
+    def admit_gossip(self, tx: bytes) -> bool:
+        """Gate a gossiped tx under pressure: bulk sheds (False, counted),
+        priority always passes — the admitted lane's quorums must keep
+        forming, so priority ingest is never paused."""
+        if not self.cfg.enabled or not self.overloaded():
+            return True
+        if self.lane_of(tx) == LANE_PRIORITY:
+            return True
+        self.metrics.rejected_gossip.add(1)
+        return False
+
+    def gossip_paused(self) -> bool:
+        """Should the mempool reactor pause its BULK broadcast walk?
+        (The priority walk and vote gossip never pause.)"""
+        return self.cfg.enabled and self.overloaded()

@@ -431,28 +431,55 @@ _HOT_ATTRS = {
 }
 
 
+# admit-path functions that must never block: they run inline on every
+# RPC handler thread and the gossip receive path, so one blocking call
+# stalls the whole front door (the shed path must stay O(1) — that is
+# the backpressure contract). Checked against the same blocking-call
+# vocabulary as lock-blocking, with NO lock held.
+_HOT_NOBLOCK_FUNCS = {
+    "txflow_tpu/admission/controller.py": {
+        "admit_rpc", "admit_gossip", "lane_of", "overloaded",
+        "_bulk_shed", "_bulk_rate_exceeded", "forget", "gossip_paused",
+    },
+}
+
+
 class HotPathPass(LintPass):
     name = "hotpath-sync"
 
     def run(self, module: ModuleSource) -> list[Violation]:
-        hot = _HOT_FUNCS.get(module.path)
-        if not hot:
+        hot = _HOT_FUNCS.get(module.path, set())
+        noblock = _HOT_NOBLOCK_FUNCS.get(module.path, set())
+        if not hot and not noblock:
             return []
         out: list[Violation] = []
         for node in ast.walk(module.tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            if node.name not in hot:
-                continue
-            for sub in ast.walk(node):
-                if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
-                    attr = sub.func.attr
-                    if attr in _HOT_ATTRS:
+            if node.name in hot:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+                        attr = sub.func.attr
+                        if attr in _HOT_ATTRS:
+                            out.append(
+                                Violation(
+                                    self.name, module.path, sub.lineno,
+                                    f".{attr}() in hot function {node.name}: "
+                                    f"{_HOT_ATTRS[attr]}",
+                                )
+                            )
+            if node.name in noblock:
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    reason = _blocking_reason(sub, held=())
+                    if reason is not None:
                         out.append(
                             Violation(
                                 self.name, module.path, sub.lineno,
-                                f".{attr}() in hot function {node.name}: "
-                                f"{_HOT_ATTRS[attr]}",
+                                f"blocking {reason} in admit-path function "
+                                f"{node.name}: the front door must shed, "
+                                f"never stall",
                             )
                         )
         return out

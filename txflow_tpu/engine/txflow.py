@@ -221,6 +221,15 @@ class TxFlow:
         # instrumented profile).
         self._drain_cursor = 0
         self._retry: list[tuple[bytes, TxVote]] = []
+        # priority-lane drain (admission subsystem): priority-tx votes are
+        # drained through the pool's priority log AHEAD of the main-log
+        # walk, so under a deep bulk backlog they verify in the NEXT step
+        # instead of queueing behind thousands of bulk votes. Keys drained
+        # this way are remembered until the main-log cursor passes them
+        # (each appears in the main log exactly once), so no vote is
+        # prepped twice.
+        self._prio_drain_cursor = 0
+        self._prio_drained: set[bytes] = set()
         self._mtx = make_rlock("engine.TxFlow._mtx")
         self._running = False
         self._thread: threading.Thread | None = None
@@ -658,11 +667,34 @@ class TxFlow:
         # post-step snapshot
         drain_seq = self.tx_vote_pool.seq()
         with self._mtx:
-            raw, self._drain_cursor = self.tx_vote_pool.entries_from(
-                self._drain_cursor,
+            # priority-lane votes first: under overload the main log can
+            # be thousands of bulk votes deep, and a priority tx's quorum
+            # must not wait out that backlog (admission lanes, ISSUE 6)
+            praw, self._prio_drain_cursor = self.tx_vote_pool.priority_entries_from(
+                self._prio_drain_cursor,
                 limit=max(target - len(self._retry), 0),
             )
-            batch = self._retry + [(k, v) for k, v, _h, _s in raw]
+            drained = self._prio_drained
+            drained.update(k for k, _v, _h, _s in praw)
+            raw, self._drain_cursor = self.tx_vote_pool.entries_from(
+                self._drain_cursor,
+                limit=max(target - len(self._retry) - len(praw), 0),
+            )
+            fresh: list[tuple[bytes, TxVote]] = []
+            for k, v, _h, _s in raw:
+                if k in drained:
+                    drained.discard(k)  # main log reached it: done tracking
+                    continue
+                fresh.append((k, v))
+            if len(drained) > 8192:
+                # keys whose main-log entry was compacted away before the
+                # cursor reached them (committed early) would accumulate;
+                # keep only keys the pool still holds
+                has = self.tx_vote_pool.has
+                self._prio_drained = {k for k in drained if has(k)}
+            batch = (
+                self._retry + [(k, v) for k, v, _h, _s in praw] + fresh
+            )
             self._retry = []
             if not batch:
                 return None

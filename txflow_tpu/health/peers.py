@@ -68,8 +68,10 @@ class PeerScoreBoard:
         self.cfg = cfg
         self.registry = registry
         # reconnector(node_id) -> bool: re-establish the link to node_id.
-        # LocalNet wires in-memory re-pipes; a TCP assembly would wire a
-        # dial through its address book.
+        # LocalNet wires in-memory re-pipes; TCP assemblies get the
+        # address-book-backed default (p2p.pex.book_reconnector), which
+        # Node auto-wires whenever the switch has a node key and a PEX
+        # book.
         self.reconnector = reconnector
         self._tracks: dict[str, _PeerTrack] = {}
         self._backoff_level: dict[str, int] = {}

@@ -10,5 +10,6 @@ in-memory pipes: the reference's in-process-testnet pattern
 
 from .node import Node, NodeConfig
 from .localnet import LocalNet
+from .procnet import ProcNet
 
-__all__ = ["Node", "NodeConfig", "LocalNet"]
+__all__ = ["Node", "NodeConfig", "LocalNet", "ProcNet"]

@@ -85,6 +85,23 @@ class NodeConfig:
     health: bool = True
     # HealthConfig override (None = defaults; see health/config.py)
     health_config: object = None
+    # overload-resilient front door (admission/): edge dedup before any
+    # signature work, pool-pressure backpressure to RPC (429) and ingest
+    # gossip, fee/priority mempool lanes. False = open door (seed
+    # behavior)
+    admission: bool = True
+    # AdmissionConfig override (None = defaults; see admission/config.py)
+    admission_config: object = None
+    # tx -> lane callable override (None = the fee-prefix classifier);
+    # must be a deterministic function of the tx bytes
+    lane_classifier: object = None
+    # PEX address-book reactor (p2p/pex.py): learns/persists peer dial
+    # addresses and keeps the mesh connected; also feeds the health
+    # layer's reconnect hook. None = auto (on exactly when config.p2p.pex
+    # and the switch has a node key, i.e. real TCP assemblies)
+    pex: bool | None = None
+    # address-book persistence path ("" = in-memory only)
+    addrbook_path: str = ""
 
 
 class Node:
@@ -174,6 +191,27 @@ class Node:
         # per-node registry: N in-proc nodes must not share counters
         self.metrics_registry = Registry()
         self.metrics = TxFlowMetrics(self.metrics_registry)
+
+        # -- admission front door (admission/): sits between the RPC/
+        # gossip edges and the mempool; also supplies the pool's lane
+        # classifier so every ingress path lands txs in the right lane --
+        self.admission = None
+        if nc.admission:
+            from ..admission import AdmissionController
+
+            self.admission = AdmissionController(
+                self.mempool,
+                cfg=nc.admission_config,
+                registry=self.metrics_registry,
+                classifier=nc.lane_classifier,
+            )
+            self.mempool.lane_of = self.admission.lane_of
+            # votes inherit their tx's lane (vote.tx_key -> mempool entry),
+            # so the verify engine's priority drain covers the whole
+            # commit path, not just the mempool walks
+            self.tx_vote_pool.lane_of_vote = (
+                lambda vote, _pool=self.mempool: _pool.lane_of_key(vote.tx_key)
+            )
         self.tx_executor = TxExecutor(
             self.proxy_app.consensus, self.mempool, self.event_bus, self.metrics
         )
@@ -221,6 +259,7 @@ class Node:
             broadcast=mp_bcast,
             batch_size=nc.gossip_batch,
             regossip_interval=nc.regossip_interval,
+            admission=self.admission,
         )
         self.txvote_reactor = TxVoteReactor(
             self.state_view,
@@ -233,6 +272,24 @@ class Node:
         )
         self.switch.add_reactor("mempool", self.mempool_reactor)
         self.switch.add_reactor("txvote", self.txvote_reactor)
+
+        # -- PEX address book (p2p/pex.py; reference p2p/pex — channel
+        # 0x00): auto-on for keyed TCP assemblies, where dial addresses
+        # are learnable and re-dials authenticate; in-memory LocalNet
+        # pipes have no dialable addresses, so auto stays off there --
+        self.address_book = None
+        self.pex = None
+        pex_on = (
+            self.config.p2p.pex and nc.node_key_seed is not None
+            if nc.pex is None
+            else nc.pex
+        )
+        if pex_on:
+            from ..p2p.pex import AddressBook, PEXReactor
+
+            self.address_book = AddressBook(nc.addrbook_path)
+            self.pex = PEXReactor(self.address_book)
+            self.switch.add_reactor("pex", self.pex)
 
         # -- evidence pool + reactor (node/node.go:354-367; channel 0x38) --
         from ..pool.evidence import EvidencePool
@@ -303,6 +360,15 @@ class Node:
             from ..health import HealthMonitor
 
             self.health = HealthMonitor(self, nc.health_config)
+            if self.address_book is not None:
+                # default reconnect hook for TCP assemblies: evicted
+                # peers re-dial via the PEX address book (the jittered
+                # backoff lives in the scoreboard — health/peers.py)
+                from ..p2p.pex import book_reconnector
+
+                self.health.set_reconnector(
+                    book_reconnector(self.switch, self.address_book)
+                )
 
         self._started = False
 

@@ -1,0 +1,133 @@
+"""ProcNet: an N-node validator network in SEPARATE OS processes over
+real TCP — the multi-process extension of LocalNet's in-proc testnet.
+
+Each child runs ``python -m txflow_tpu.node.procnode`` (one JSON spec
+line in, one JSON info line out); the parent broadcasts the peer address
+map and every child's PEX ensure-loop dials the mesh together. All
+interaction from then on is an external client's: HTTP RPC and the
+Prometheus exposition over real sockets. ``tools/soak.py --overload``
+drives its overload/chaos soak through this harness.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class ProcNet:
+    def __init__(self, n: int = 3, spec: dict | None = None):
+        """spec: the procnode spec-line template (see procnode.py); the
+        parent fills in ``index``/``n`` per child. Per-child overrides go
+        under spec["per_node"][index] and are merged on top."""
+        self.n = n
+        self.spec = dict(spec or {})
+        self.children: list[subprocess.Popen] = []
+        self.infos: list[dict] = []
+
+    # -- lifecycle --
+
+    def start(self, timeout: float = 60.0) -> None:
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO_ROOT)
+        per_node = self.spec.pop("per_node", {}) or {}
+        for i in range(self.n):
+            child = subprocess.Popen(
+                [sys.executable, "-m", "txflow_tpu.node.procnode"],
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                env=env,
+            )
+            self.children.append(child)
+            spec = dict(self.spec, index=i, n=self.n)
+            spec.update(per_node.get(i) or per_node.get(str(i)) or {})
+            child.stdin.write(json.dumps(spec) + "\n")
+            child.stdin.flush()
+        deadline = time.monotonic() + timeout
+        for i, child in enumerate(self.children):
+            line = child.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"procnode {i} died during startup:\n{self._stderr_tail(i)}"
+                )
+            self.infos.append(json.loads(line))
+        peers = {info["node_id"]: info["p2p"] for info in self.infos}
+        for child in self.children:
+            child.stdin.write(json.dumps({"peers": peers}) + "\n")
+            child.stdin.flush()
+        # the mesh forms via each child's PEX ensure-loop; wait for full
+        # connectivity before handing the net to the caller
+        while True:
+            try:
+                if all(
+                    self.rpc_json(i, "/net_info")["result"]["n_peers"] >= self.n - 1
+                    for i in range(self.n)
+                ):
+                    return
+            except (OSError, ValueError, KeyError):
+                pass
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "procnet mesh did not form: "
+                    + ", ".join(
+                        str(self.rpc_json(i, "/net_info")["result"]["n_peers"])
+                        for i in range(self.n)
+                    )
+                )
+            time.sleep(0.1)
+
+    def stop(self, timeout: float = 15.0) -> None:
+        for child in self.children:
+            try:
+                child.stdin.close()  # procnode exits on stdin EOF
+            except OSError:
+                pass
+        deadline = time.monotonic() + timeout
+        for child in self.children:
+            try:
+                child.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                child.kill()
+        self.children = []
+        self.infos = []
+
+    def _stderr_tail(self, i: int, n: int = 4000) -> str:
+        try:
+            return (self.children[i].stderr.read() or "")[-n:]
+        except (OSError, ValueError):
+            return "<stderr unavailable>"
+
+    # -- client surface (everything over real sockets) --
+
+    def rpc_addr(self, i: int) -> tuple[str, int]:
+        host, port = self.infos[i]["rpc"]
+        return host, int(port)
+
+    def rpc_json(self, i: int, path: str, timeout: float = 30.0) -> dict:
+        host, port = self.rpc_addr(i)
+        with urllib.request.urlopen(
+            f"http://{host}:{port}{path}", timeout=timeout
+        ) as r:
+            return json.loads(r.read().decode())
+
+    def metrics_value(self, i: int, name: str) -> float | None:
+        """Sum of the samples for one metric name in node i's Prometheus
+        exposition; None when the metric is absent."""
+        host, port = self.rpc_addr(i)
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=30
+        ) as r:
+            text = r.read().decode()
+        total, seen = 0.0, False
+        for line in text.splitlines():
+            if line.startswith(name + " "):
+                total += float(line.split()[-1])
+                seen = True
+        return total if seen else None

@@ -1,0 +1,142 @@
+"""Child-process node entrypoint for multi-process real-socket nets.
+
+Run as ``python -m txflow_tpu.node.procnode``: reads ONE JSON spec line
+from stdin, assembles validator ``index`` of an ``n``-validator set whose
+keys are derived deterministically from ``seed_prefix`` (every child
+derives the SAME set with no key exchange), starts the node with real
+TCP listen + ephemeral RPC, prints one JSON info line on stdout, then
+seeds its PEX address book from the peers line the parent broadcasts —
+the PEX ensure-loop dials the mesh together from there.
+
+Spec line fields (all optional except index/n/seed_prefix):
+
+    {"index": 0, "n": 3, "chain_id": "txflow-proc",
+     "seed_prefix": "soak1",
+     "mempool": {"size": 200},             # MempoolConfig field overrides
+     "engine": {"max_batch": 64},          # EngineConfig field overrides
+     "admission": {"retry_after": 0.5},    # AdmissionConfig kwargs
+     "health": {"score_floor": -4.0},      # HealthConfig kwargs
+     "fault": {"drop": 0.02, "seed": 7},   # FaultSpec kwargs (chaos on)
+     "regossip": 0.25,
+     "blackhole": {"start": 3.0, "duration": 2.0}}
+
+``blackhole`` makes THIS child's chaos router partition itself away for
+the window: its outbound gossip black-holes, so its PEERS observe
+send-attempts-without-progress, evict it by score, and heal the link
+through their address-book re-dial (dial handshakes bypass chaos) —
+the real-network self-healing path ISSUE 6's soak asserts.
+
+The child exits when its stdin closes (parent teardown) or on SIGTERM.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+import threading
+import time
+
+
+def main() -> None:
+    spec = json.loads(sys.stdin.readline())
+    index = int(spec["index"])
+    n = int(spec["n"])
+    prefix = spec.get("seed_prefix", "procnet")
+    chain_id = spec.get("chain_id", "txflow-proc")
+
+    from ..abci.kvstore import KVStoreApplication
+    from ..faults.chaos import ChaosRouter
+    from ..faults.plan import FaultSpec
+    from ..types.priv_validator import MockPV
+    from ..types.validator import Validator, ValidatorSet
+    from ..utils.config import test_config
+    from .node import Node, NodeConfig
+
+    pvs = [
+        MockPV(hashlib.sha256(f"{prefix}-val{i}".encode()).digest())
+        for i in range(n)
+    ]
+    vs = ValidatorSet([Validator.from_pub_key(pv.get_pub_key(), 10) for pv in pvs])
+    by_addr = {pv.get_address(): pv for pv in pvs}
+    me = by_addr[vs.get_by_index(index).address]
+
+    config = test_config()
+    for k, v in (spec.get("mempool") or {}).items():
+        setattr(config.mempool, k, v)
+    for k, v in (spec.get("engine") or {}).items():
+        setattr(config.engine, k, v)
+
+    admission_config = None
+    if spec.get("admission"):
+        from ..admission import AdmissionConfig
+
+        admission_config = AdmissionConfig(**spec["admission"])
+    health_config = None
+    if spec.get("health"):
+        from ..health.config import HealthConfig
+
+        health_config = HealthConfig(**spec["health"])
+
+    node = Node(
+        node_id=f"proc-{index}",
+        chain_id=chain_id,
+        val_set=vs,
+        app=KVStoreApplication(),
+        priv_val=me,
+        node_config=NodeConfig(
+            config=config,
+            use_device_verifier=False,
+            enable_consensus=False,
+            rpc_port=0,
+            node_key_seed=hashlib.sha256(f"{prefix}-key-{index}".encode()).digest(),
+            regossip_interval=spec.get("regossip", 0.25),
+            admission_config=admission_config,
+            health_config=health_config,
+        ),
+    )
+
+    router = None
+    if spec.get("fault"):
+        # install BEFORE start so every peer (dialed or accepted) gets the
+        # interceptor; each child has its OWN router — partitioning this
+        # node's id black-holes only its outbound gossip
+        router = ChaosRouter(FaultSpec(**spec["fault"]))
+        router.install([node.switch])
+
+    node.start()
+    host, port = node.switch.listen_tcp("127.0.0.1", 0)
+    rhost, rport = node.rpc.addr
+    print(
+        json.dumps(
+            {"node_id": node.switch.node_id, "p2p": [host, port], "rpc": [rhost, rport]}
+        ),
+        flush=True,
+    )
+
+    # peers line: {"peers": {node_id: [host, port], ...}} — seed the book;
+    # the PEX ensure-loop does the dialing (and keeps re-dialing)
+    peers = json.loads(sys.stdin.readline())["peers"]
+    for nid, (phost, pport) in peers.items():
+        if nid != node.switch.node_id and node.address_book is not None:
+            node.address_book.add(nid, phost, int(pport))
+
+    bh = spec.get("blackhole")
+    if bh and router is not None:
+
+        def _blackhole(r=router, me_id=node.switch.node_id):
+            time.sleep(float(bh.get("start", 3.0)))
+            r.partition([me_id])
+            time.sleep(float(bh.get("duration", 2.0)))
+            r.heal()
+
+        threading.Thread(target=_blackhole, name="blackhole", daemon=True).start()
+
+    # park until the parent closes our stdin
+    while sys.stdin.readline():
+        pass
+    node.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -78,6 +78,28 @@ class AddressBook:
         os.replace(tmp, self.path)
 
 
+def book_reconnector(switch, book: AddressBook):
+    """Default PeerScoreBoard reconnect hook for TCP assemblies: re-dial
+    an evicted peer at its address-book entry. Node auto-wires this into
+    the health monitor whenever the switch has a node key and a PEX book
+    (node/node.py); the jittered retry backoff lives in the scoreboard
+    (health/peers.py) — this hook is one dial attempt."""
+
+    def reconnect(node_id: str) -> bool:
+        addr = book.get(node_id)
+        if addr is None:
+            return False
+        try:
+            peer = switch.dial_tcp(addr[0], addr[1])
+        except Exception:
+            return False
+        # the secret-connection handshake verifies who answered: a stale
+        # book entry that now serves a DIFFERENT node is a failure
+        return peer is not None and peer.node_id == node_id
+
+    return reconnect
+
+
 class PEXReactor(Reactor):
     def __init__(self, book: AddressBook, max_peers: int = 50):
         super().__init__("pex")

@@ -30,13 +30,70 @@ import threading
 
 from ..analysis.lockgraph import make_lock
 
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:  # OpenSSL-backed AEAD when available (the normal case)
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+except ImportError:  # pragma: no cover - environment-dependent
+    ChaCha20Poly1305 = None
 
 from ..crypto import ed25519, x25519
 from ..crypto.hash import address_hash
 from .transport import MAX_FRAME_BYTES, ConnectionClosed
 
 _LEN = struct.Struct("!I")
+
+
+class _HashlibAEAD:
+    """Stdlib-only AEAD with the ChaCha20Poly1305 call surface.
+
+    Used only when the ``cryptography`` package is absent: encrypt-then-MAC
+    with an HMAC-SHA256 keystream in counter mode and a 16-byte truncated
+    HMAC-SHA256 tag over nonce||aad||ciphertext. Same 16-byte overhead as
+    Poly1305, so the frame-length cap math is unchanged. Both endpoints of
+    a deployment run the same image, so the two AEADs never need to
+    interoperate on the wire.
+    """
+
+    _TAG = 16
+
+    def __init__(self, key: bytes):
+        self._enc_key = hashlib.sha256(b"txflow-aead-enc" + key).digest()
+        self._mac_key = hashlib.sha256(b"txflow-aead-mac" + key).digest()
+
+    def _keystream(self, nonce: bytes, n: int) -> bytes:
+        out = bytearray()
+        block = 0
+        while len(out) < n:
+            out += hmac_mod.new(
+                self._enc_key, nonce + block.to_bytes(8, "little"), hashlib.sha256
+            ).digest()
+            block += 1
+        return bytes(out[:n])
+
+    @staticmethod
+    def _xor(a: bytes, b: bytes) -> bytes:
+        return (int.from_bytes(a, "little") ^ int.from_bytes(b, "little")).to_bytes(
+            len(a), "little"
+        )
+
+    def _tag(self, nonce: bytes, aad: bytes, ct: bytes) -> bytes:
+        return hmac_mod.new(
+            self._mac_key, nonce + aad + ct, hashlib.sha256
+        ).digest()[: self._TAG]
+
+    def encrypt(self, nonce: bytes, data: bytes, aad: bytes) -> bytes:
+        ct = self._xor(data, self._keystream(nonce, len(data)))
+        return ct + self._tag(nonce, aad or b"", ct)
+
+    def decrypt(self, nonce: bytes, data: bytes, aad: bytes) -> bytes:
+        if len(data) < self._TAG:
+            raise ValueError("aead: frame shorter than tag")
+        ct, tag = data[: -self._TAG], data[-self._TAG :]
+        if not hmac_mod.compare_digest(tag, self._tag(nonce, aad or b"", ct)):
+            raise ValueError("aead: tag mismatch")
+        return self._xor(ct, self._keystream(nonce, len(ct)))
+
+
+_AEAD = ChaCha20Poly1305 if ChaCha20Poly1305 is not None else _HashlibAEAD
 
 
 def _hkdf_sha256(ikm: bytes, info: bytes, n: int) -> bytes:
@@ -105,12 +162,8 @@ class SecretConnection:
         material = _hkdf_sha256(shared, b"txflow-secret-conn" + lo + hi, 96)
         key_lo_to_hi, key_hi_to_lo = material[:32], material[32:64]
         challenge = material[64:]
-        self._send_aead = ChaCha20Poly1305(
-            key_lo_to_hi if we_are_lo else key_hi_to_lo
-        )
-        self._recv_aead = ChaCha20Poly1305(
-            key_hi_to_lo if we_are_lo else key_lo_to_hi
-        )
+        self._send_aead = _AEAD(key_lo_to_hi if we_are_lo else key_hi_to_lo)
+        self._recv_aead = _AEAD(key_hi_to_lo if we_are_lo else key_lo_to_hi)
         self._send_ctr = 0
         self._recv_ctr = 0
 

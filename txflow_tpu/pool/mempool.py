@@ -20,7 +20,14 @@ from ..crypto.hash import sha256
 from ..utils.cache import make_lru
 from ..utils.config import MempoolConfig
 from ..utils.wal import WAL
-from .base import IngestLogPool
+from .base import COMPACT_THRESHOLD, IngestLogPool
+
+# mempool lanes (admission subsystem, admission/): priority txs keep
+# committing at flat p50 under overload while bulk traffic sheds at the
+# edges. Constants live HERE so admission can import them without the
+# pool ever importing admission.
+LANE_PRIORITY = 0
+LANE_BULK = 1
 
 
 class ErrTxInCache(Exception):
@@ -62,6 +69,7 @@ class _MempoolTx:
     tx: bytes
     senders: set[int] = field(default_factory=set)
     fast_path: bool = True  # app CheckTx verdict (ResponseCheckTx.fast_path)
+    lane: int = LANE_BULK  # admission lane (classifier verdict at insert)
 
 
 class Mempool(IngestLogPool):
@@ -83,6 +91,15 @@ class Mempool(IngestLogPool):
         self._txs: dict[bytes, _MempoolTx] = self._items  # tx_key -> entry
         self._txs_bytes = 0
         self.cache = make_lru(config.cache_size)
+        # admission lanes: lane_of is the classifier hook (tx -> lane,
+        # set by the node's AdmissionController; None = everything bulk).
+        # The priority lane keeps its OWN compacted ingest log so the
+        # sign/gossip walkers can serve priority txs first without
+        # scanning past an arbitrarily deep bulk backlog.
+        self.lane_of = None
+        self._prio_log: list[bytes] = []
+        self._prio_log_base = 0  # absolute position of _prio_log[0]
+        self._lane_counts = [0, 0]  # live entries per lane (PRIORITY, BULK)
         self._txs_available = threading.Event()
         self._notified_txs_available = False
         self._notify_available = False
@@ -254,10 +271,21 @@ class Mempool(IngestLogPool):
             self.wal.write(tx)  # txlint: allow(lock-blocking) -- WAL append order must match insertion order; buffered write, fsync only if sync_on_write
         gas = res.gas_wanted if res is not None else 0
         fast_path = getattr(res, "fast_path", True) if res is not None else True
+        lane = LANE_BULK
+        if self.lane_of is not None:
+            try:
+                lane = self.lane_of(tx)
+            except Exception:
+                lane = LANE_BULK  # a hostile tx must not error the insert
+            if lane != LANE_PRIORITY:
+                lane = LANE_BULK
         entry = _MempoolTx(
-            self.height, gas, tx, {tx_info.sender_id}, fast_path
+            self.height, gas, tx, {tx_info.sender_id}, fast_path, lane
         )
         self._txs[key] = entry
+        self._lane_counts[lane] += 1
+        if lane == LANE_PRIORITY:
+            self._prio_log.append(key)
         if notify:
             self._log_append(key)
         else:
@@ -321,12 +349,34 @@ class Mempool(IngestLogPool):
             entry = self._txs.get(tx_key)
             return entry is not None and sender_id in entry.senders
 
+    def lane_of_key(self, tx_key: bytes) -> int:
+        """Admission lane of a pooled tx (LANE_BULK when unknown/gone).
+        Lock-free like get_tx: content-addressed, and the lane verdict is
+        immutable per entry. Votes inherit their tx's lane through this
+        (TxVotePool.lane_of_vote), so the verify engine can drain
+        priority-tx votes ahead of a deep bulk backlog."""
+        entry = self._txs.get(tx_key)
+        return entry.lane if entry is not None else LANE_BULK
+
     # -- reap (reference :306-355) --
+
+    def _reap_order(self):
+        """Iteration order for reaps (call under _mtx): priority-lane
+        entries first, insertion order within each lane — block inclusion
+        under overload must not strand the priority lane behind a full
+        bulk backlog. The common no-priority case stays the plain dict
+        walk (no copy)."""
+        if self._lane_counts[LANE_PRIORITY] == 0:
+            return self._txs.values()
+        entries = list(self._txs.values())
+        return [e for e in entries if e.lane == LANE_PRIORITY] + [
+            e for e in entries if e.lane != LANE_PRIORITY
+        ]
 
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
         with self._mtx:
             out, total_bytes, total_gas = [], 0, 0
-            for entry in self._txs.values():
+            for entry in self._reap_order():
                 if max_bytes > -1 and total_bytes + len(entry.tx) > max_bytes:
                     break
                 if max_gas > -1 and total_gas + entry.gas_wanted > max_gas:
@@ -340,7 +390,7 @@ class Mempool(IngestLogPool):
         with self._mtx:
             if n < 0:
                 n = len(self._txs)
-            return [e.tx for _, e in list(self._txs.items())[:n]]
+            return [e.tx for e in list(self._reap_order())[:n]]
 
     def entries(self, after: int = 0, limit: int = -1) -> list[tuple[bytes, bytes]]:
         """Snapshot of (tx_key, tx) pairs in insertion order (gossip walk)."""
@@ -352,12 +402,46 @@ class Mempool(IngestLogPool):
 
     def entries_from(
         self, cursor: int, limit: int = 256
-    ) -> tuple[list[tuple[bytes, bytes, int, bool]], int]:
+    ) -> tuple[list[tuple[bytes, bytes, int, bool, int]], int]:
         """Stable-cursor walk of live txs: (tx_key, tx, height,
-        fast_path) tuples; see IngestLogPool._entries_from for the
+        fast_path, lane) tuples; see IngestLogPool._entries_from for the
         cursor contract."""
         raw, pos = self._entries_from(cursor, limit)
-        return [(k, e.tx, e.height, e.fast_path) for k, e in raw], pos
+        return [(k, e.tx, e.height, e.fast_path, e.lane) for k, e in raw], pos
+
+    def priority_entries_from(
+        self, cursor: int, limit: int = 256
+    ) -> tuple[list[tuple[bytes, bytes, int, bool, int]], int]:
+        """entries_from over the PRIORITY lane only: same tuple shape and
+        cursor contract, but walking the priority ingest log — O(priority
+        backlog), independent of how deep the bulk backlog is."""
+        out: list[tuple[bytes, bytes, int, bool, int]] = []
+        with self._mtx:
+            pos = max(cursor, self._prio_log_base)
+            while pos - self._prio_log_base < len(self._prio_log) and len(out) < limit:
+                key = self._prio_log[pos - self._prio_log_base]
+                e = self._txs.get(key)
+                if e is not None and e.lane == LANE_PRIORITY:
+                    out.append((key, e.tx, e.height, e.fast_path, e.lane))
+                pos += 1
+        return out, pos
+
+    def lane_size(self, lane: int) -> int:
+        """Live entries in one admission lane (O(1); admission headroom)."""
+        with self._mtx:
+            return self._lane_counts[lane]
+
+    def _prio_compact(self) -> None:
+        """_log_compact's twin for the priority log (call under _mtx)."""
+        log = self._prio_log
+        if len(log) - self._lane_counts[LANE_PRIORITY] < COMPACT_THRESHOLD:
+            return
+        n = 0
+        while n < len(log) and log[n] not in self._txs:
+            n += 1
+        if n >= COMPACT_THRESHOLD:
+            del log[:n]
+            self._prio_log_base += n
 
     # -- update on commit (reference :358-422) --
 
@@ -403,7 +487,9 @@ class Mempool(IngestLogPool):
             entry = self._txs.pop(key, None)
             if entry is not None:
                 self._txs_bytes -= len(entry.tx)
+                self._lane_counts[entry.lane] -= 1
         self._log_compact()
+        self._prio_compact()
         if len(self._txs) > 0:
             self._notify_txs_available()
 
@@ -424,6 +510,7 @@ class Mempool(IngestLogPool):
                 if not self.cache.push(key):
                     continue
                 self._txs[key] = _MempoolTx(self.height, 0, tx, {0})
+                self._lane_counts[LANE_BULK] += 1
                 self._log_append(key)
                 self._txs_bytes += len(tx)
             if len(self._txs) > 0:
@@ -434,6 +521,9 @@ class Mempool(IngestLogPool):
             self._txs.clear()
             self._log_base += len(self._log)
             self._log.clear()
+            self._prio_log_base += len(self._prio_log)
+            self._prio_log.clear()
+            self._lane_counts = [0, 0]
             self._txs_bytes = 0
             self.cache.reset()
 

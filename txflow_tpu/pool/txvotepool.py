@@ -28,8 +28,14 @@ from ..types import TxVote, decode_tx_vote, encode_tx_vote
 from ..utils.cache import make_lru
 from ..utils.config import MempoolConfig
 from ..utils.wal import WAL
-from .base import IngestLogPool
-from .mempool import ErrMempoolIsFull, ErrTxInCache, ErrTxTooLarge, TxInfo
+from .base import COMPACT_THRESHOLD, IngestLogPool
+from .mempool import (
+    LANE_PRIORITY,
+    ErrMempoolIsFull,
+    ErrTxInCache,
+    ErrTxTooLarge,
+    TxInfo,
+)
 
 UNKNOWN_PEER_ID = 0
 
@@ -69,6 +75,15 @@ class TxVotePool(IngestLogPool):
         # the inlined check_tx_many twin) and every removal path.
         self._by_tx: dict[str, dict[bytes, None]] = {}
         self._votes_bytes = 0
+        # vote-pool lanes: a vote inherits its tx's admission lane via the
+        # lane_of_vote hook (Node wires mempool.lane_of_key over the
+        # vote's tx_key); the priority log lets the verify engine drain
+        # priority-tx votes ahead of a deep bulk backlog — the same
+        # compacted-ingest-log design as Mempool._prio_log. Hook faults
+        # demote to bulk: a hostile vote must not error the ingest path.
+        self.lane_of_vote = None
+        self._prio_log: list[bytes] = []
+        self._prio_log_base = 0  # absolute position of _prio_log[0]
         self.cache = make_lru(config.cache_size)
         self._txs_available = threading.Event()
         self._notified_txs_available = False
@@ -162,6 +177,34 @@ class TxVotePool(IngestLogPool):
             entry.senders.add(sender_id)
             return True
 
+    def _lane_quiet(self, vote: TxVote) -> int:
+        """lane_of_vote with the hook-fault demotion applied (any error,
+        or no hook, means bulk)."""
+        if self.lane_of_vote is None:
+            return -1
+        try:
+            return self.lane_of_vote(vote)
+        except Exception:
+            return -1
+
+    def _evict_bulk_locked(self) -> bool:
+        """Evict the OLDEST bulk-lane vote to make room for a priority
+        vote (call under _mtx, pool full). Bulk occupancy must never
+        block priority ingest: under overload the vote pool fills with
+        bulk votes, and a bounced priority vote is a quorum signature
+        lost — the sign walk has already moved past the tx. The evicted
+        vote leaves the dedup cache too, so peer regossip re-delivers it
+        once the pool drains (same retryability as a full-pool bounce)."""
+        for k, e in self._votes.items():
+            if self._lane_quiet(e.vote) == LANE_PRIORITY:
+                continue
+            self._votes.pop(k)
+            self._votes_bytes -= e.size
+            self._index_discard(k, e)
+            self.cache.remove(k)
+            return True
+        return False
+
     # -- ingest (reference CheckTx/CheckTxWithInfo :180-261) --
 
     def check_tx(
@@ -202,6 +245,8 @@ class TxVotePool(IngestLogPool):
         cache_push = self.cache.push
         votes_d = self._votes
         log_append = self._log_append_quiet  # one _log_notify per group
+        lane_of = self.lane_of_vote
+        prio_append = self._prio_log.append
         wal = self.wal if write_wal else None
         oset = object.__setattr__
         new = _PoolVote.__new__
@@ -217,6 +262,18 @@ class TxVotePool(IngestLogPool):
                     if encoded is None:
                         encoded = encode_tx_vote(vote)
                     vote_size = len(encoded)
+                    lane = -1
+                    if lane_of is not None:
+                        try:
+                            lane = lane_of(vote)
+                        except Exception:
+                            lane = -1
+                    while (
+                        len(votes_d) >= cfg.size
+                        or vote_size + self._votes_bytes > cfg.max_txs_bytes
+                    ):
+                        if lane != LANE_PRIORITY or not self._evict_bulk_locked():
+                            break
                     if (
                         len(votes_d) >= cfg.size
                         or vote_size + self._votes_bytes > cfg.max_txs_bytes
@@ -256,6 +313,8 @@ class TxVotePool(IngestLogPool):
                         by_tx = self._by_tx[vote.tx_hash] = {}
                     by_tx[key] = None
                     log_append(key)
+                    if lane == LANE_PRIORITY:
+                        prio_append(key)
                     self._votes_bytes += vote_size
                     accepted = True
                 if accepted:  # an all-dup group must not wake consumers
@@ -274,6 +333,13 @@ class TxVotePool(IngestLogPool):
         """One vote's acceptance decision + insertion (under self._mtx);
         availability notification is the caller's (so frames notify once)."""
         vote_size = len(encoded)
+        lane = self._lane_quiet(vote)
+        while (
+            len(self._votes) >= self.config.size
+            or vote_size + self._votes_bytes > self.config.max_txs_bytes
+        ):
+            if lane != LANE_PRIORITY or not self._evict_bulk_locked():
+                break
         if (
             len(self._votes) >= self.config.size
             or vote_size + self._votes_bytes > self.config.max_txs_bytes
@@ -307,6 +373,8 @@ class TxVotePool(IngestLogPool):
             by_tx = self._by_tx[vote.tx_hash] = {}
         by_tx[key] = None
         self._log_append(key)
+        if lane == LANE_PRIORITY:
+            self._prio_log.append(key)
         self._votes_bytes += vote_size
 
     def _notify_txs_available(self) -> None:
@@ -350,6 +418,35 @@ class TxVotePool(IngestLogPool):
         raw, pos = self._entries_from(cursor, limit)
         return [(k, e.vote, e.height, e.seg) for k, e in raw], pos
 
+    def priority_entries_from(
+        self, cursor: int, limit: int = 256
+    ) -> tuple[list[tuple[bytes, TxVote, int, bytes]], int]:
+        """entries_from over priority-lane votes only: same tuple shape
+        and cursor contract, walking the priority ingest log — O(priority
+        backlog), independent of how deep the bulk vote backlog is. The
+        verify engine drains this BEFORE the main log so priority txs
+        reach quorum at a flat latency under overload."""
+        out: list[tuple[bytes, TxVote, int, bytes]] = []
+        with self._mtx:
+            pos = max(cursor, self._prio_log_base)
+            while pos - self._prio_log_base < len(self._prio_log) and len(out) < limit:
+                key = self._prio_log[pos - self._prio_log_base]
+                e = self._votes.get(key)
+                if e is not None:
+                    out.append((key, e.vote, e.height, e.seg))
+                pos += 1
+        return out, pos
+
+    def _prio_compact(self) -> None:
+        """_log_compact's twin for the priority log (call under _mtx)."""
+        log = self._prio_log
+        n = 0
+        while n < len(log) and log[n] not in self._votes:
+            n += 1
+        if n >= COMPACT_THRESHOLD:
+            del log[:n]
+            self._prio_log_base += n
+
     def segs_for_tx(self, tx_hash: str, limit: int = 512) -> list[bytes]:
         """Wire segments of every live vote for one tx (the quorum-stall
         watchdog's targeted re-offer input, health/watchdog.py). Walks the
@@ -387,6 +484,7 @@ class TxVotePool(IngestLogPool):
                 if cache_too:
                     self.cache.remove(k)
             self._log_compact()
+            self._prio_compact()
 
     # -- update on commit (reference Update :329-359) --
 
@@ -403,6 +501,7 @@ class TxVotePool(IngestLogPool):
                     self._votes_bytes -= entry.size
                     self._index_discard(k, entry)
             self._log_compact()
+            self._prio_compact()
             if len(self._votes) > 0:
                 self._notify_txs_available()
 
@@ -412,5 +511,7 @@ class TxVotePool(IngestLogPool):
             self._by_tx.clear()
             self._log_base += len(self._log)
             self._log.clear()
+            self._prio_log_base += len(self._prio_log)
+            self._prio_log.clear()
             self._votes_bytes = 0
             self.cache.reset()

@@ -16,7 +16,14 @@ import time
 
 from ..codec import amino
 from ..p2p.base import CHANNEL_MEMPOOL, ChannelDescriptor, Reactor
-from ..pool.mempool import ErrMempoolIsFull, ErrTxInCache, ErrTxTooLarge, Mempool, TxInfo
+from ..pool.mempool import (
+    LANE_PRIORITY,
+    ErrMempoolIsFull,
+    ErrTxInCache,
+    ErrTxTooLarge,
+    Mempool,
+    TxInfo,
+)
 
 MSG_TXS = 1
 MSG_HEIGHT = 2
@@ -48,9 +55,14 @@ class MempoolReactor(Reactor):
         batch_size: int = 1024,
         poll_interval: float = 0.05,
         regossip_interval: float | None = None,
+        admission=None,
     ):
         super().__init__("mempool")
         self.mempool = mempool
+        # AdmissionController (or None): sheds gossiped bulk txs before
+        # CheckTx and pauses the BULK broadcast walk under overload —
+        # priority tx gossip and vote gossip (txvote reactor) never pause
+        self.admission = admission
         self.broadcast = broadcast
         self.batch_size = batch_size
         self.poll_interval = poll_interval
@@ -116,7 +128,10 @@ class MempoolReactor(Reactor):
         if msg_type == MSG_TXS:
             txs = decode_tx_batch(msg[1:])  # decode error -> peer stopped
             pid = self._peer_id(peer)
+            adm = self.admission
             for tx in txs:
+                if adm is not None and not adm.admit_gossip(tx):
+                    continue  # bulk shed before CheckTx under overload
                 try:
                     self.mempool.check_tx(tx, TxInfo(sender_id=pid))
                 except ErrTxInCache:
@@ -135,14 +150,28 @@ class MempoolReactor(Reactor):
     def _broadcast_routine(self, peer) -> None:
         pid = self._peer_id(peer)
         cursor = 0
-        pending: list[tuple[bytes, bytes, int, bool]] = []
+        pcursor = 0
+        pending: list[tuple[bytes, bytes, int, bool, int]] = []
         seq = self.mempool.seq()
         last_rewalk = time.monotonic()
         while self._running.is_set() and peer.is_running():
             if not pending:
-                pending, cursor = self.mempool.entries_from(
-                    cursor, limit=self.batch_size
+                # priority lane first; the bulk walk pauses entirely while
+                # the admission controller reports overload (backpressure
+                # on ingest gossip — vote gossip is a different reactor
+                # and never pauses)
+                pending, pcursor = self.mempool.priority_entries_from(
+                    pcursor, limit=self.batch_size
                 )
+            if not pending:
+                adm = self.admission
+                if adm is None or not adm.gossip_paused():
+                    bulk, cursor = self.mempool.entries_from(
+                        cursor, limit=self.batch_size
+                    )
+                    pending = [it for it in bulk if it[4] != LANE_PRIORITY]
+                    if not pending and bulk:
+                        continue  # page was all-priority: keep walking
             if not pending:
                 if (
                     self.regossip_interval is not None
@@ -150,15 +179,17 @@ class MempoolReactor(Reactor):
                     and self.mempool.size() > 0
                 ):
                     cursor = 0  # anti-entropy re-walk (see __init__)
+                    pcursor = 0
                     last_rewalk = time.monotonic()
                     continue
                 seq = self.mempool.wait_for_new(seq, timeout=self.poll_interval)
                 continue
             peer_height = peer.get(PEER_HEIGHT_KEY, 0)
             sendable, deferred = [], []
-            for key, tx, h, _fp in pending:
+            for item in pending:
+                key, tx, h = item[0], item[1], item[2]
                 if h - 1 > peer_height:  # allow a lag of 1 block (:236-239)
-                    deferred.append((key, tx, h, _fp))
+                    deferred.append(item)
                 elif not self.mempool.has_sender(key, pid):
                     sendable.append(tx)
             if sendable:

@@ -29,7 +29,14 @@ from typing import Callable
 
 from ..codec import amino
 from ..p2p.base import CHANNEL_TXVOTE, ChannelDescriptor, Reactor
-from ..pool.mempool import ErrMempoolIsFull, ErrTxInCache, ErrTxTooLarge, Mempool, TxInfo
+from ..pool.mempool import (
+    LANE_PRIORITY,
+    ErrMempoolIsFull,
+    ErrTxInCache,
+    ErrTxTooLarge,
+    Mempool,
+    TxInfo,
+)
 from ..pool.txvotepool import TxVotePool
 from ..crypto.hash import sha256
 from ..types import TxVote, encode_tx_vote
@@ -261,9 +268,17 @@ class TxVoteReactor(Reactor):
 
     def _sign_tx_routine(self) -> None:
         cursor = 0
+        pcursor = 0
         seq = self.mempool.seq()
         while self._running.is_set():
+            # drain the priority lane first each pass: under overload the
+            # bulk walk can be arbitrarily deep, and priority txs must
+            # reach quorum at a flat latency regardless (ISSUE 6)
+            pitems, pcursor = self.mempool.priority_entries_from(
+                pcursor, limit=self.batch_size
+            )
             items, cursor = self.mempool.entries_from(cursor, limit=self.batch_size)
+            items = pitems + [it for it in items if it[4] != LANE_PRIORITY]
             if not items:
                 seq = self.mempool.wait_for_new(seq, timeout=self.poll_interval)
                 continue
@@ -273,7 +288,7 @@ class TxVoteReactor(Reactor):
             my_addr = self.priv_val.get_address()
             if not st.validators.has_address(my_addr):
                 continue  # keep running: could become a validator any round
-            for tx_key, tx, _h, fast_path in items:
+            for tx_key, tx, _h, fast_path, _lane in items:
                 if not fast_path:
                     # app flagged this tx block-only (e.g. EndBlock-
                     # coupled validator updates): honest validators do

@@ -30,6 +30,20 @@ import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..admission import ErrDuplicateTx, ErrOverloaded
+from ..pool.mempool import ErrMempoolIsFull, ErrTxInCache
+
+
+class RPCError(Exception):
+    """Raise from a route handler to control the HTTP status/headers of
+    the reply (the generic handler-exception path is a blanket 500)."""
+
+    def __init__(self, status: int, body: dict, headers: dict | None = None):
+        super().__init__(f"rpc error {status}")
+        self.status = status
+        self.body = body
+        self.headers = headers or {}
+
 
 def _parse_tx_param(raw: str) -> bytes:
     """tendermint-style tx param: 0x-hex or a (possibly quoted) string."""
@@ -68,18 +82,47 @@ MAX_OPEN_CONNECTIONS = 128
 
 class _BoundedHTTPServer(ThreadingHTTPServer):
     """ThreadingHTTPServer with a hard cap on concurrent connections:
-    past MAX_OPEN_CONNECTIONS the listener closes new sockets immediately
-    instead of spawning an unbounded thread per connection (a connection
-    flood would otherwise exhaust threads/filedescriptors)."""
+    past MAX_OPEN_CONNECTIONS the listener sheds new sockets with a
+    best-effort 503 instead of spawning an unbounded thread per
+    connection (a connection flood would otherwise exhaust threads/
+    filedescriptors). Shed connections are COUNTED — a silent bare reset
+    made overload invisible to both clients and dashboards."""
 
     daemon_threads = True
+    # bounded kernel accept backlog: under a connection flood the excess
+    # queues (briefly) in the kernel instead of growing handler state
+    request_queue_size = 64
 
-    def __init__(self, addr, handler):
+    _REJECT_BODY = json.dumps({"error": "too many open connections"}).encode()
+    _REJECT_RESPONSE = (
+        b"HTTP/1.1 503 Service Unavailable\r\n"
+        b"Content-Type: application/json\r\n"
+        b"Content-Length: " + str(len(_REJECT_BODY)).encode() + b"\r\n"
+        b"Retry-After: 1\r\n"
+        b"Connection: close\r\n\r\n" + _REJECT_BODY
+    )
+
+    def __init__(self, addr, handler, metrics_registry=None):
         self._conn_sem = threading.Semaphore(MAX_OPEN_CONNECTIONS)
+        self._rejected = None
+        if metrics_registry is not None:
+            self._rejected = metrics_registry.counter(
+                "rpc", "rejected_total",
+                "connections shed at the RPC listener (over the open-conn cap)",
+            )
         super().__init__(addr, handler)
 
     def process_request(self, request, client_address):
         if not self._conn_sem.acquire(blocking=False):
+            if self._rejected is not None:
+                self._rejected.add(1)
+            try:
+                # minimal pre-built 503 so the client sees backpressure,
+                # not a bare RST; best-effort (the flood case is exactly
+                # when sends may fail)
+                request.sendall(self._REJECT_RESPONSE)
+            except OSError:
+                pass
             try:
                 request.close()
             except OSError:
@@ -117,11 +160,13 @@ class RPCServer:
             def log_message(self, fmt, *args):  # quiet by default
                 pass
 
-            def _reply(self, obj, code=200):
+            def _reply(self, obj, code=200, headers=None):
                 payload = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(payload)
 
@@ -225,10 +270,16 @@ class RPCServer:
                         self._reply_text(result)
                     else:
                         self._reply({"result": result})
+                except RPCError as e:
+                    # typed status replies (429 overload + Retry-After)
+                    self._reply(e.body, e.status, e.headers)
                 except Exception as e:
                     self._reply({"error": repr(e)}, 500)
 
-        self._httpd = _BoundedHTTPServer((host, port), Handler)
+        self._httpd = _BoundedHTTPServer(
+            (host, port), Handler,
+            metrics_registry=getattr(node, "metrics_registry", None),
+        )
         self.addr = self._httpd.server_address
         self._thread: threading.Thread | None = None
         self._routes = {
@@ -276,10 +327,51 @@ class RPCServer:
 
     # -- handlers --
 
+    @staticmethod
+    def _dup_result(key: bytes) -> dict:
+        """The ONE duplicate-submission reply: edge-dedup hits and
+        mempool-cache hits both answer through here, so the two paths are
+        byte-identical on the wire (ISSUE 6 satellite)."""
+        return {"hash": key.hex().upper(), "code": 0, "duplicate": True}
+
+    @staticmethod
+    def _overload_error(retry_after: float) -> RPCError:
+        return RPCError(
+            429,
+            {"error": "overloaded", "retry_after": retry_after},
+            {"Retry-After": str(max(1, int(round(retry_after))))},
+        )
+
     def _broadcast_tx(self, q: dict) -> dict:
         tx = _parse_tx_param(q["tx"])
-        self.node.broadcast_tx(tx)
-        return {"hash": hashlib.sha256(tx).hexdigest().upper(), "code": 0}
+        key = hashlib.sha256(tx).digest()
+        adm = getattr(self.node, "admission", None)
+        if adm is not None:
+            try:
+                adm.admit_rpc(tx, key)
+            except ErrDuplicateTx:
+                return self._dup_result(key)
+            except ErrOverloaded as e:
+                raise self._overload_error(e.retry_after)
+        try:
+            self.node.broadcast_tx(tx)
+        except ErrTxInCache:
+            # first sighting at THIS edge but the pool already has it
+            # (e.g. it arrived by gossip): same dup verdict as the edge
+            return self._dup_result(key)
+        except ErrMempoolIsFull:
+            # pool rejected after edge admit: release the dedup slot so
+            # the client's post-Retry-After resubmit isn't dup-bounced
+            if adm is not None:
+                adm.forget(key)
+            raise self._overload_error(
+                adm.cfg.retry_after if adm is not None else 1.0
+            )
+        except Exception:
+            if adm is not None:
+                adm.forget(key)
+            raise
+        return {"hash": key.hex().upper(), "code": 0}
 
     def _broadcast_tx_commit(self, q: dict) -> dict:
         """Submit + wait for the commit in one call (tendermint's

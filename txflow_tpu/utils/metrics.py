@@ -156,6 +156,25 @@ class HealthMetrics:
         self.pipeline_depth_now = r.gauge("health", "pipeline_depth", "engine's current (possibly adaptive) pipeline depth")
 
 
+class AdmissionMetrics:
+    """Front-door admission metrics (admission/ subsystem).
+
+    Every shed path counts: rejected traffic must be visible in the
+    exposition, never a silent drop (ISSUE 6 acceptance). Gauges mirror
+    the controller's cached overload verdict so dashboards and the
+    429-emitting RPC read the same state."""
+
+    def __init__(self, registry: "Registry | None" = None):
+        r = registry or GLOBAL
+        self.admitted_priority = r.counter("admission", "admitted_priority", "priority-lane txs admitted at the RPC edge")
+        self.admitted_bulk = r.counter("admission", "admitted_bulk", "bulk-lane txs admitted at the RPC edge")
+        self.rejected_dup = r.counter("admission", "rejected_dup", "replayed tx bytes rejected by the edge dedup")
+        self.rejected_overload = r.counter("admission", "rejected_overload", "bulk txs shed at the RPC edge (429) under overload/headroom")
+        self.rejected_gossip = r.counter("admission", "rejected_gossip", "gossiped bulk txs shed before CheckTx under overload")
+        self.overloaded = r.gauge("admission", "overloaded", "1 = pool past high water (hysteresis)")
+        self.occupancy = r.gauge("admission", "pool_occupancy", "pool fill fraction at the last pressure poll")
+
+
 class TxFlowMetrics:
     """Fast-path metrics (reference txflowstate/metrics.go:17-45)."""
 
