@@ -165,6 +165,24 @@ def run_bench(platform: str) -> dict:
     from txflow_tpu.utils.events import EventTx
 
     n_vals = int(os.environ.get("BENCH_VALIDATORS", "4"))
+    # --stake-dist {uniform,whale,longtail} (or BENCH_STAKE_DIST): run the
+    # same corpus under a non-uniform stake distribution (faults/stake.py).
+    # Uniform powers never exercise the interesting quorum geometry — a
+    # whale's single vote being 1/3+ of total, or a long tail where 2n/3
+    # needs most of the set — and throughput can differ because quorums
+    # latch after different vote counts per tx.
+    from txflow_tpu.faults.stake import gini, stake_distribution
+
+    stake_dist = os.environ.get("BENCH_STAKE_DIST", "uniform")
+    if "--stake-dist" in sys.argv:
+        stake_dist = sys.argv[sys.argv.index("--stake-dist") + 1]
+    if stake_dist not in ("uniform", "whale", "longtail"):
+        raise ValueError(
+            f"--stake-dist must be uniform|whale|longtail, got {stake_dist!r}"
+        )
+    stake_powers = stake_distribution(
+        stake_dist, n_vals, seed=int(os.environ.get("BENCH_STAKE_SEED", "0"))
+    )
     # On the CPU fallback the TPU-shaped curve kernel is ~100x slower than
     # host crypto, so the bench drops to the framework's documented
     # fallback rung (SURVEY §7 hard-part 1): the scalar host verifier
@@ -191,7 +209,10 @@ def run_bench(platform: str) -> dict:
             MockPV(_h.sha256(b"localnet-val%d" % i).digest()) for i in range(n_vals)
         ]
         val_set = ValidatorSet(
-            [Validator.from_pub_key(pv.get_pub_key(), 10) for pv in priv_vals]
+            [
+                Validator.from_pub_key(pv.get_pub_key(), p)
+                for pv, p in zip(priv_vals, stake_powers)
+            ]
         )
         bucket = int(os.environ.get("BENCH_BUCKET", "4096"))
         # cross-engine verify-result cache (verifier.VerifyCache): the 4
@@ -275,7 +296,10 @@ def run_bench(platform: str) -> dict:
             MockPV(_h.sha256(b"localnet-val%d" % i).digest()) for i in range(n_vals)
         ]
         val_set = ValidatorSet(
-            [Validator.from_pub_key(pv.get_pub_key(), 10) for pv in priv_vals]
+            [
+                Validator.from_pub_key(pv.get_pub_key(), p)
+                for pv, p in zip(priv_vals, stake_powers)
+            ]
         )
         if os.environ.get("BENCH_SHARE_CACHE", "1") == "1":
             shared_verifier = ScalarVoteVerifier(val_set, shared_cache=True)
@@ -385,6 +409,7 @@ def run_bench(platform: str) -> dict:
         enable_consensus=with_consensus,
         index_txs=False,  # nothing queries /tx_search during the bench
         n_nodes=n_nodes,
+        voting_powers=stake_powers,
     )
 
     # -- pregenerate txs + every validator's votes (untimed) --
@@ -587,6 +612,11 @@ def run_bench(platform: str) -> dict:
         "committed_votes": committed,
         "wall_s": round(wall, 3),
         "app_commit_interval": cfg.engine.commit_interval,
+        # stake geometry of the run: the Gini coefficient summarizes how
+        # concentrated the distribution was (0 = uniform), so two runs'
+        # numbers are comparable without re-deriving the power list
+        "stake_dist": stake_dist,
+        "stake_gini": round(gini(stake_powers), 4),
     }
     if verifier_kind == "device":
         result["device_step_votes_per_sec"] = device_step_votes_per_sec

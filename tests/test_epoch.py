@@ -1,0 +1,573 @@
+"""Dynamic validator sets: epoch rotation, evidence-driven slashing, and
+quorum safety under power churn (epoch/ + the engine/verifier restage
+path), driven at three levels:
+
+- pure units: EpochManager's deterministic chain fold, the stake
+  distribution generator, and ValidatorSet/quorum properties at the
+  exact 2n/3 boundary under non-uniform stake;
+- engine: a mid-run set change revalidates in-flight TxVoteSets (votes
+  from removed validators discarded, survivors re-weighted, rotation
+  itself can push a pending tx over the line), never mutates an
+  already-latched certificate, and triggers ZERO in-run compiles on the
+  device verifier (restage = two device_puts on the same shapes);
+- LocalNet drills (tier-1): slash-the-equivocator and
+  rotation-under-partition, both ending with every node on the
+  identical validator-set hash.
+"""
+
+import hashlib
+import random
+import time
+
+import pytest
+
+from txflow_tpu.abci import AppConns, KVStoreApplication
+from txflow_tpu.engine import TxExecutor, TxFlow
+from txflow_tpu.epoch import EpochConfig, EpochManager
+from txflow_tpu.faults import FaultSpec
+from txflow_tpu.faults.byzantine import equivocating_block_votes
+from txflow_tpu.faults.stake import (
+    KINDS,
+    churn_schedule,
+    gini,
+    stake_distribution,
+)
+from txflow_tpu.node.localnet import LocalNet
+from txflow_tpu.pool import Mempool, TxVotePool
+from txflow_tpu.store import MemDB, TxStore
+from txflow_tpu.types import MockPV, TxVote, Validator, ValidatorSet
+from txflow_tpu.types.vote_set import TxVoteSet
+from txflow_tpu.utils.config import (
+    EngineConfig,
+    MempoolConfig,
+    test_config as make_test_config,
+)
+
+CHAIN_ID = "txflow-localnet"  # LocalNet default
+ENGINE_CHAIN = "txflow-epoch-test"
+
+
+def wait_until(pred, timeout=20.0, poll=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    return False
+
+
+def make_pvs(n=4, powers=None, tag=b"epoch-val"):
+    pvs = sorted(
+        (MockPV(hashlib.sha256(tag + b"%d" % i).digest()) for i in range(n)),
+        key=lambda p: p.get_address(),
+    )
+    powers = powers or [10] * n
+    vals = ValidatorSet(
+        [Validator.from_pub_key(pv.get_pub_key(), p) for pv, p in zip(pvs, powers)]
+    )
+    by_addr = {pv.get_address(): pv for pv in pvs}
+    return [by_addr[v.address] for v in vals], vals
+
+
+def reweighted(pvs, vals, powers):
+    """Same validators (minus any with power 0), new powers, pv order."""
+    by_addr = {pv.get_address(): p for pv, p in zip(pvs, powers)}
+    return ValidatorSet(
+        [
+            Validator.from_pub_key(pv.get_pub_key(), by_addr[pv.get_address()])
+            for pv in pvs
+            if by_addr[pv.get_address()] > 0
+        ]
+    )
+
+
+def make_engine(vals, use_device=False, verifier=None):
+    conns = AppConns(KVStoreApplication())
+    mempool = Mempool(MempoolConfig(cache_size=1000), conns.mempool)
+    commitpool = Mempool(MempoolConfig(cache_size=1000))
+    votepool = TxVotePool(MempoolConfig(cache_size=10000))
+    tx_store = TxStore(MemDB())
+    execu = TxExecutor(conns.consensus, mempool)
+    flow = TxFlow(
+        ENGINE_CHAIN,
+        1,
+        vals,
+        votepool,
+        mempool,
+        commitpool,
+        execu,
+        tx_store,
+        config=EngineConfig(max_batch=1024, use_device=use_device),
+        verifier=verifier,
+    )
+    return flow, mempool, votepool, tx_store
+
+
+def sign_vote(pv, tx: bytes, height=1, chain=ENGINE_CHAIN) -> TxVote:
+    v = TxVote(
+        height=height,
+        tx_hash=hashlib.sha256(tx).hexdigest().upper(),
+        tx_key=hashlib.sha256(tx).digest(),
+        timestamp_ns=1700000000_000000000,
+        validator_address=pv.get_address(),
+    )
+    pv.sign_tx_vote(chain, v)
+    return v
+
+
+# ----------------------------------------------- stake distributions
+
+
+def test_stake_distribution_deterministic_and_shaped():
+    for kind in KINDS:
+        a = stake_distribution(kind, 8, seed=3)
+        b = stake_distribution(kind, 8, seed=3)
+        assert a == b, f"{kind}: same seed must reproduce the same powers"
+        assert len(a) == 8 and all(p >= 1 for p in a)
+        assert stake_distribution(kind, 8, seed=4) != a or kind == "uniform"
+    assert gini(stake_distribution("uniform", 8)) == 0.0
+    # concentration ordering: whale and longtail are strictly unequal
+    assert gini(stake_distribution("whale", 8)) > 0.0
+    assert gini(stake_distribution("longtail", 8)) > 0.0
+    with pytest.raises(ValueError):
+        stake_distribution("nope", 4)
+
+
+def test_churn_schedule_covers_epochs():
+    pubs = [b"\x01" * 32, b"\x02" * 32, b"\x03" * 32]
+    sched = churn_schedule(pubs, 3, seed=1)
+    assert sorted(sched) == [0, 1, 2]
+    for entries in sched.values():
+        assert [pk for pk, _ in entries] == pubs
+        assert all(p >= 1 for _, p in entries)
+    assert sched == churn_schedule(pubs, 3, seed=1)
+
+
+# ------------------------------- quorum properties at the 2n/3 boundary
+
+
+def test_quorum_power_exact_two_thirds_boundary_property():
+    """quorum_power is the MINIMAL stake strictly exceeding 2/3 of the
+    total, for every stake geometry the generator can produce: a random
+    subset's stake reaches quorum iff 3*s > 2*total, never at exactly
+    2n/3."""
+    rng = random.Random(1234)
+    for kind in KINDS:
+        for trial in range(6):
+            n = rng.randrange(1, 12)
+            powers = stake_distribution(kind, n, seed=trial)
+            _, vs = make_pvs(n, powers, tag=b"q%d-" % trial + kind.encode())
+            total = vs.total_voting_power()
+            q = vs.quorum_power()
+            assert q == total * 2 // 3 + 1
+            assert 3 * q > 2 * total, "quorum must strictly exceed 2/3"
+            assert 3 * (q - 1) <= 2 * total, "quorum must be minimal"
+            for _ in range(20):
+                subset = [v for v in vs if rng.random() < 0.5]
+                s = sum(v.voting_power for v in subset)
+                assert (s >= q) == (3 * s > 2 * total), (
+                    f"{kind}: subset stake {s}/{total} disagrees with the "
+                    f"2/3 rule at quorum {q}"
+                )
+
+
+def test_update_with_change_set_property_under_churn():
+    """Randomized churn (re-weights, removals, a joiner) over whale and
+    long-tail sets: the returned set has exactly the expected membership
+    and powers, the ORIGINAL set is untouched, and the new quorum is
+    consistent with the new total."""
+    rng = random.Random(99)
+    for kind in ("whale", "longtail"):
+        for trial in range(8):
+            n = rng.randrange(2, 10)
+            powers = stake_distribution(kind, n, seed=100 + trial)
+            pvs, vs = make_pvs(n, powers, tag=b"c%d-" % trial + kind.encode())
+            orig_hash = vs.hash()
+            orig_total = vs.total_voting_power()
+            expected = {v.address: (v.pub_key, v.voting_power) for v in vs}
+            updates = []
+            survivors = n
+            for v in list(vs):
+                r = rng.random()
+                if r < 0.3 and survivors > 1:
+                    updates.append((v.pub_key, 0))
+                    del expected[v.address]
+                    survivors -= 1
+                elif r < 0.6:
+                    p = rng.randrange(1, 50)
+                    updates.append((v.pub_key, p))
+                    expected[v.address] = (v.pub_key, p)
+            joiner = MockPV(hashlib.sha256(b"joiner%d" % trial).digest())
+            jp = rng.randrange(1, 50)
+            jval = Validator.from_pub_key(joiner.get_pub_key(), jp)
+            updates.append((joiner.get_pub_key(), jp))
+            expected[jval.address] = (joiner.get_pub_key(), jp)
+
+            new = vs.update_with_change_set(updates)
+            assert {v.address: (v.pub_key, v.voting_power) for v in new} == expected
+            new_total = sum(p for _, p in expected.values())
+            assert new.total_voting_power() == new_total
+            assert new.quorum_power() == new_total * 2 // 3 + 1
+            # the original set is immutable
+            assert vs.hash() == orig_hash
+            assert vs.total_voting_power() == orig_total
+
+
+# --------------------------------------------------- EpochManager fold
+
+
+class _Blk:
+    def __init__(self, height, evidence=()):
+        self.height = height
+        self.evidence = list(evidence)
+
+
+class _St:
+    def __init__(self, vs):
+        self.next_validators = vs
+
+
+def test_epoch_manager_slashes_at_boundary_once_per_epoch():
+    pvs, vs = make_pvs(2, [10, 10], tag=b"mgr-val")
+    mgr = EpochManager(EpochConfig(length=4, slash_fraction=0.5))
+    ev = equivocating_block_votes(pvs[0], "mgr-chain", height=2)
+    st = _St(vs)
+    assert mgr.end_block_updates(_Blk(1), st, []) == []
+    assert mgr.end_block_updates(_Blk(2, [ev]), st, []) == []
+    # second offense same epoch: deduplicated
+    ev2 = equivocating_block_votes(pvs[0], "mgr-chain", height=3, round_=1)
+    assert mgr.end_block_updates(_Blk(3, [ev2]), st, []) == []
+    changes = mgr.end_block_updates(_Blk(4), st, [])
+    assert changes == [(pvs[0].get_pub_key(), 5)], "10 * (1-0.5) = 5, once"
+    assert mgr.slashes_applied == 1
+    # replayed block below the watermark must not re-arm the offense
+    assert mgr.end_block_updates(_Blk(2, [ev]), st, []) == []
+    assert mgr.end_block_updates(_Blk(8), st, []) == []
+    assert mgr.boundaries_crossed == 2
+
+
+def test_epoch_manager_full_slash_never_empties_the_set():
+    """slash_fraction=1.0 removes — but removing the only validator
+    would halt the chain, so the change degrades to a token power 1
+    (liveness beats punishment)."""
+    pvs, vs = make_pvs(1, [10], tag=b"solo-val")
+    mgr = EpochManager(EpochConfig(length=2, slash_fraction=1.0))
+    ev = equivocating_block_votes(pvs[0], "solo-chain", height=1)
+    st = _St(vs)
+    mgr.end_block_updates(_Blk(1, [ev]), st, [])
+    changes = mgr.end_block_updates(_Blk(2), st, [])
+    assert changes == [(pvs[0].get_pub_key(), 1)]
+    vs.update_with_change_set(changes)  # must apply cleanly
+
+
+def test_epoch_manager_scheduled_rotation_and_rebuild():
+    pvs, vs = make_pvs(2, [10, 10], tag=b"rot-val")
+    joiner = MockPV(hashlib.sha256(b"rot-joiner").digest())
+    cfg = EpochConfig(length=2, schedule={0: [(joiner.get_pub_key(), 7)]})
+    mgr = EpochManager(cfg)
+    st = _St(vs)
+    assert mgr.end_block_updates(_Blk(2), st, []) == [(joiner.get_pub_key(), 7)]
+    assert mgr.rotations_applied == 1
+
+    # rebuild refills the pending map from the current partial epoch only
+    ev = equivocating_block_votes(pvs[0], "rot-chain", height=3)
+    blocks = {1: _Blk(1), 2: _Blk(2), 3: _Blk(3, [ev])}
+
+    class _Store:
+        def load_block(self, h):
+            return blocks.get(h)
+
+    mgr2 = EpochManager(EpochConfig(length=2, slash_fraction=1.0))
+    mgr2.rebuild(_Store(), 3)
+    snap = mgr2.snapshot()
+    assert snap["pending_slashes"] == 1
+    assert snap["pending_addrs"] == [pvs[0].get_address().hex()]
+    assert snap["last_boundary_height"] == 2
+
+
+# --------------------------------------- in-flight vote sets under churn
+
+
+def test_vote_set_revalidate_drops_reweights_and_latches():
+    pvs, vs = make_pvs(4, [10, 10, 10, 10])
+    tx = b"reval=1"
+    tvs = TxVoteSet(
+        ENGINE_CHAIN, 1, hashlib.sha256(tx).hexdigest().upper(),
+        hashlib.sha256(tx).digest(), vs,
+    )
+    for pv in pvs[:2]:  # 20 < 27: in flight
+        added, err = tvs.add_vote(sign_vote(pv, tx))
+        assert added, err
+    assert not tvs.maj23
+    # pvs[0] removed, pvs[1] boosted to 40: survivor stake 40 >= 34
+    new_vs = reweighted(pvs, vs, [0, 40, 5, 5])
+    dropped, quorate = tvs.revalidate(new_vs)
+    assert (dropped, quorate) == (1, True)
+    assert tvs.maj23 and tvs.sum == 40
+    assert pvs[0].get_address() not in tvs.votes
+
+
+def test_vote_set_revalidate_latched_certificate_is_immutable():
+    pvs, vs = make_pvs(4, [10, 10, 10, 10])
+    tx = b"latched=1"
+    tvs = TxVoteSet(
+        ENGINE_CHAIN, 1, hashlib.sha256(tx).hexdigest().upper(),
+        hashlib.sha256(tx).digest(), vs,
+    )
+    for pv in pvs[:3]:  # 30 >= 27: latched
+        tvs.add_vote(sign_vote(pv, tx))
+    assert tvs.maj23
+    before = {a: v.signature for a, v in tvs.votes.items()}
+    # even a set that removes every certified voter must not touch it
+    dropped, quorate = tvs.revalidate(reweighted(pvs, vs, [0, 0, 0, 10]))
+    assert (dropped, quorate) == (0, False)
+    assert {a: v.signature for a, v in tvs.votes.items()} == before
+    assert tvs.sum == 30 and tvs.val_set is vs
+
+
+# -------------------------------------------------- engine rotation path
+
+
+def test_engine_rotation_revalidates_inflight_and_commits():
+    """Mid-run set change on the scalar path: the committed certificate
+    stays byte-identical, the removed validator's in-flight vote is
+    discarded, and the rotation itself pushes the survivor over the NEW
+    quorum (commit on rotation, no new votes needed)."""
+    pvs, vals = make_pvs(4, [10, 10, 10, 10])
+    flow, mempool, votepool, tx_store = make_engine(vals)
+    tx_a, tx_b = b"epochA=1", b"epochB=2"
+    mempool.check_tx(tx_a)
+    mempool.check_tx(tx_b)
+    for pv in pvs[:3]:  # tx_a: 30 >= 27, commits
+        votepool.check_tx(sign_vote(pv, tx_a))
+    for pv in pvs[:2]:  # tx_b: 20 < 27, in flight
+        votepool.check_tx(sign_vote(pv, tx_b))
+    flow.step()
+    h_a = hashlib.sha256(tx_a).hexdigest().upper()
+    h_b = hashlib.sha256(tx_b).hexdigest().upper()
+    cert_a = tx_store.load_tx_commit(h_a)
+    assert cert_a is not None and len(cert_a.commits) == 3
+    before = [(c.validator_address, c.signature) for c in cert_a.commits]
+    assert tx_store.load_tx_commit(h_b) is None
+
+    # rotation: pvs[0] slashed out, pvs[1] boosted 10 -> 40
+    # (total 50, quorum 34: pvs[1]'s surviving vote alone is quorate)
+    new_vals = reweighted(pvs, vals, [0, 40, 5, 5])
+    flow.update_state(2, new_vals)
+
+    rot = flow.last_rotation
+    assert rot is not None and rot["restaged"] is True
+    assert rot["votes_dropped"] == 1
+    assert rot["commits_on_rotation"] == 1
+    assert rot["val_set_hash"] == new_vals.hash().hex()
+    # tx_b committed BY the rotation, certified under the new set
+    cert_b = tx_store.load_tx_commit(h_b)
+    assert cert_b is not None and len(cert_b.commits) == 1
+    assert cert_b.commits[0].validator_address == pvs[1].get_address()
+    # the pre-rotation certificate was not mutated
+    after = [
+        (c.validator_address, c.signature)
+        for c in tx_store.load_tx_commit(h_a).commits
+    ]
+    assert after == before
+
+
+def test_engine_device_rotation_restages_without_recompile():
+    """The zero-recompile contract on the device path: a mid-run set
+    change with unchanged validator count swaps the staged tables in
+    place (restage), the bucket ladder stays keyed by batch size, and
+    the post-rotation batch runs on the EXACT shapes the pre-rotation
+    batch compiled — shapes_used must not grow."""
+    from txflow_tpu.verifier import DeviceVoteVerifier
+
+    pvs, vals = make_pvs(4, [10, 10, 10, 10])
+    dv = DeviceVoteVerifier(vals, buckets=(16,))
+    flow, mempool, votepool, tx_store = make_engine(
+        vals, use_device=True, verifier=dv
+    )
+    round1 = [b"warm%d=v" % i for i in range(4)]
+    for tx in round1:
+        mempool.check_tx(tx)
+        for pv in pvs[:3]:
+            votepool.check_tx(sign_vote(pv, tx))
+    flow.step()
+    for tx in round1:
+        assert tx_store.load_tx_commit(hashlib.sha256(tx).hexdigest().upper())
+
+    shapes_before = set(dv.shapes_used)
+    assert shapes_before, "round 1 must have exercised the device path"
+    cap_before = dv.capacity
+    buckets_before = dv.buckets
+
+    new_vals = reweighted(pvs, vals, [20, 10, 10, 10])
+    flow.update_state(2, new_vals)
+    assert flow.last_rotation["restaged"] is True, (
+        "same validator count must restage in place, not rebuild"
+    )
+    assert dv.val_set.hash() == new_vals.hash()
+    assert dv.capacity == cap_before and dv.buckets == buckets_before
+
+    round2 = [b"rot%d=v" % i for i in range(4)]
+    for tx in round2:
+        mempool.check_tx(tx)
+        for pv in pvs[:3]:
+            votepool.check_tx(sign_vote(pv, tx, height=2))
+    flow.step()
+    for tx in round2:
+        # total 50, quorum 34; pvs[:3] carry 20+10+10 or 10+10+10+... —
+        # whichever three signed, their stake under the new set clears it
+        assert tx_store.load_tx_commit(hashlib.sha256(tx).hexdigest().upper())
+    assert set(dv.shapes_used) == shapes_before, (
+        "a set change must never introduce a new compiled shape "
+        f"(before={shapes_before}, after={set(dv.shapes_used)})"
+    )
+
+
+# --------------------------------------------------- LocalNet drills
+
+
+def _all_val_hashes(net):
+    return {n.state_view().validators.hash() for n in net.nodes}
+
+
+def _chain_tx_order(node, up_to):
+    out = []
+    for h in range(1, up_to + 1):
+        b = node.block_store.load_block(h)
+        if b is not None:
+            out.append((h, tuple(b.vtxs), tuple(b.txs)))
+    return out
+
+
+def test_drill_slash_the_equivocator():
+    """A double-signing validator's equivocation evidence lands on-chain
+    and, within one epoch boundary (+H+2), every node derives the same
+    3-validator set with the offender's quorum contribution zeroed —
+    and the network keeps committing with the reduced set."""
+    cfg = make_test_config()
+    cfg.consensus.skip_timeout_commit = True
+    net = LocalNet(
+        4,
+        use_device_verifier=False,
+        enable_consensus=True,
+        config=cfg,
+        epoch_config=EpochConfig(length=4, slash_fraction=1.0),
+    )
+    offender = net.priv_vals[0]
+    off_addr = offender.get_address()
+    try:
+        net.start()
+        pre = b"pre-slash=v"
+        net.broadcast_tx(pre)
+        assert net.wait_all_committed([pre], timeout=30)
+
+        ev = equivocating_block_votes(offender, CHAIN_ID, height=1)
+        added, err = net.nodes[1].evidence_pool.add(ev)
+        assert added, err
+
+        def slashed_everywhere():
+            return all(
+                n.state_view().validators.get_by_address(off_addr)[1] is None
+                for n in net.nodes
+            )
+
+        assert wait_until(slashed_everywhere, timeout=60), (
+            "offender must leave every node's set within one epoch: "
+            f"snapshots={[n.epoch_manager.snapshot() for n in net.nodes]}"
+        )
+        # identical derived set on every node, quorum recomputed
+        assert len(_all_val_hashes(net)) == 1
+        new_set = net.nodes[0].state_view().validators
+        assert new_set.size() == 3 and new_set.total_voting_power() == 30
+        assert new_set.quorum_power() == 21
+        for n in net.nodes:
+            snap = n.epoch_manager.snapshot()
+            assert snap["slashes_applied"] >= 1
+            assert off_addr.hex() in snap["last_slashed"]
+
+        # liveness with the reduced set: a fresh tx commits everywhere,
+        # certified by the three survivors only
+        post = b"post-slash=v"
+        net.broadcast_tx(post, node_index=1)
+        assert net.wait_all_committed([post], timeout=30)
+        h = hashlib.sha256(post).hexdigest().upper()
+        for n in net.nodes:
+            votes = n.tx_store.load_tx_votes(h)
+            addrs = {v.validator_address for v in votes}
+            assert off_addr not in addrs, (
+                "a slashed validator must not contribute to new quorums"
+            )
+            stake = sum(
+                new_set.get_by_address(a)[1].voting_power for a in addrs
+            )
+            assert stake >= new_set.quorum_power()
+    finally:
+        net.stop()
+
+
+def test_drill_rotation_under_partition():
+    """A scheduled rotation (node1's power 10 -> 30) crosses its epoch
+    boundary while the network suffers a 2/2 partition. After heal,
+    every node converges to the identical rotated validator-set hash
+    and a byte-identical committed-tx order."""
+    cfg = make_test_config()
+    cfg.consensus.skip_timeout_commit = True
+    pvs = [
+        MockPV(hashlib.sha256(b"localnet-val%d" % i).digest()) for i in range(4)
+    ]
+    net = LocalNet(
+        4,
+        use_device_verifier=False,
+        enable_consensus=True,
+        config=cfg,
+        priv_vals=pvs,
+        fault_plan=FaultSpec(seed=0),
+        epoch_config=EpochConfig(
+            length=4, schedule={0: [(pvs[1].get_pub_key(), 30)]}
+        ),
+    )
+    boosted = pvs[1].get_address()
+    try:
+        net.start()
+        net.chaos.partition({"node0", "node1"})
+        cut = b"cut-rotation=v"
+        net.broadcast_tx(cut)
+        time.sleep(1.0)
+        h_cut = hashlib.sha256(cut).hexdigest().upper()
+        assert not any(n.tx_store.has_tx(h_cut) for n in net.nodes), (
+            "a 2-of-4 side holds 20 of 40 stake: below every quorum"
+        )
+        assert net.chaos.stats["partitioned"] > 0
+
+        net.chaos.heal()
+        assert net.wait_all_committed([cut], timeout=60), (
+            "liveness must resume after heal"
+        )
+
+        def rotated_everywhere():
+            for n in net.nodes:
+                _, val = n.state_view().validators.get_by_address(boosted)
+                if val is None or val.voting_power != 30:
+                    return False
+            return len(_all_val_hashes(net)) == 1
+
+        assert wait_until(rotated_everywhere, timeout=60), (
+            "scheduled rotation must reach every node after heal: "
+            f"snapshots={[n.epoch_manager.snapshot() for n in net.nodes]}"
+        )
+        new_set = net.nodes[0].state_view().validators
+        assert new_set.total_voting_power() == 60
+        assert new_set.quorum_power() == 41
+
+        # byte-identical committed-tx order across the whole network
+        post = b"post-rotation=v"
+        net.broadcast_tx(post, node_index=2)
+        assert net.wait_all_committed([post], timeout=30)
+        min_h = min(n.block_store.height() for n in net.nodes)
+        assert min_h >= 4, "the chain must have crossed the epoch boundary"
+        orders = [_chain_tx_order(n, min_h) for n in net.nodes]
+        assert all(o == orders[0] for o in orders[1:]), (
+            "nodes disagree on committed-tx order after rotation"
+        )
+    finally:
+        net.stop()

@@ -49,6 +49,37 @@ class AdmissionConfig:
     # priority lane's latency even though it never queues. Capping the
     # bulk ADMIT RATE below pipeline capacity keeps the system inside
     # its latency headroom while the flood sheds with 429 + Retry-After.
+    #
+    # When the node wires a commit_rate_source into the controller, this
+    # static value becomes only the STARTUP rate: the bucket's fill then
+    # tracks the engine's measured commit rate (see the adaptive knobs
+    # below). Static assemblies (no source) keep the PR 6 semantics.
     bulk_rate: float = 0.0
     # token-bucket burst depth (tx); 0 = one second's worth of bulk_rate
     bulk_burst: float = 0.0
+
+    # -- adaptive bulk rate (active only with a commit_rate_source) --
+    # the bucket refills at EWMA(commit rate) * headroom: slightly above
+    # what the pipeline demonstrably drains, so bulk admission can probe
+    # upward but cannot outrun commits for long
+    bulk_rate_headroom: float = 1.25
+    # never adapt below this fill rate (tx/s): a cold start or a commit
+    # stall must not latch the front door shut
+    bulk_rate_floor: float = 50.0
+    # EWMA smoothing for the sampled commit rate (per pressure poll)
+    bulk_rate_alpha: float = 0.3
+    # hysteresis band: the effective rate only moves when the new target
+    # is more than this fraction away from it — a stable workload sees a
+    # stable admit rate instead of a jittering one
+    bulk_rate_hysteresis: float = 0.2
+
+    # -- per-peer gossip rate cap (token bucket, tx/s; 0 disables) --
+    # one flooding peer must not crowd the shared ingest path; the cap is
+    # per sender and lane-blind (a hostile peer could mark everything
+    # priority, so the priority pass-through must not bypass it)
+    peer_rate: float = 0.0
+    # per-peer burst depth (tx); 0 = one second's worth of peer_rate
+    peer_burst: float = 0.0
+    # bounded number of tracked peer buckets (LRU-ish eviction of the
+    # stalest bucket when full — unbounded peer churn can't grow memory)
+    peer_max: int = 256

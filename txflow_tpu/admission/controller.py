@@ -74,6 +74,18 @@ class AdmissionController:
         # refilled lazily on each verdict, state guarded by _mtx
         self._bulk_tokens = max(self.cfg.bulk_burst, self.cfg.bulk_rate, 1.0)
         self._bulk_refill_t: float | None = None
+        # adaptive bulk rate: when the node assembly injects a cumulative
+        # committed-tx counter read here, the bucket's fill tracks the
+        # engine's measured commit rate instead of the static knob. The
+        # sample runs inside overloaded()'s cadenced branch, so the admit
+        # path stays O(1) between pressure polls.
+        self.commit_rate_source = None  # () -> cumulative committed txs
+        self._cr_count: float | None = None
+        self._cr_t: float | None = None
+        self._cr_ewma: float | None = None
+        self._bulk_rate_eff = self.cfg.bulk_rate
+        # per-peer gossip buckets: peer_id -> [tokens, last_refill_t]
+        self._peer_buckets: dict[str, list] = {}
 
     # -- lane classification (mempool.lane_of hook) --
 
@@ -100,6 +112,7 @@ class AdmissionController:
             if now < self._next_poll:
                 return self._overloaded
             self._next_poll = now + self.cfg.pressure_interval
+        self._sample_commit_rate(now)
         occ = self.mempool.size() / max(1, self.mempool.config.size)
         with self._mtx:
             if self._overloaded:
@@ -112,10 +125,56 @@ class AdmissionController:
         self.metrics.overloaded.set(1.0 if over else 0.0)
         return over
 
+    def _sample_commit_rate(self, now: float) -> None:
+        """Adaptive bulk rate: one sample per pressure poll. Reads the
+        injected cumulative committed-tx counter (a plain gauge read, no
+        locks beyond _mtx), EWMA-smooths the instantaneous rate, and
+        moves the effective bucket fill to EWMA * headroom — but only
+        when the target leaves the hysteresis band, so a steady workload
+        sees a steady admit rate. Floor stops a cold start or a commit
+        stall from latching the front door shut."""
+        src = self.commit_rate_source
+        if src is None:
+            return
+        try:
+            count = float(src())
+        except Exception:
+            return  # a faulting source must not error the admit path
+        cfg = self.cfg
+        with self._mtx:
+            if self._cr_count is None or self._cr_t is None:
+                self._cr_count, self._cr_t = count, now
+                return
+            dt = now - self._cr_t
+            if dt <= 0:
+                return
+            inst = max(0.0, count - self._cr_count) / dt
+            self._cr_count, self._cr_t = count, now
+            if self._cr_ewma is None:
+                self._cr_ewma = inst
+            else:
+                a = cfg.bulk_rate_alpha
+                self._cr_ewma = a * inst + (1.0 - a) * self._cr_ewma
+            target = max(cfg.bulk_rate_floor, self._cr_ewma * cfg.bulk_rate_headroom)
+            eff = self._bulk_rate_eff
+            if eff <= 0 or abs(target - eff) > cfg.bulk_rate_hysteresis * eff:
+                self._bulk_rate_eff = target
+            ewma = self._cr_ewma
+            eff = self._bulk_rate_eff
+        self.metrics.commit_rate.set(ewma)
+        self.metrics.bulk_rate_effective.set(eff)
+
+    def _effective_bulk_rate(self) -> float:
+        """The bucket's current fill rate: adaptive when a commit-rate
+        source is wired, else the static cfg knob (PR 6 behavior)."""
+        if self.commit_rate_source is None:
+            return self.cfg.bulk_rate
+        return self._bulk_rate_eff
+
     def _bulk_rate_exceeded(self, now: float | None = None) -> bool:
         """Token-bucket verdict for ONE bulk admission (consumes a token
-        on pass). Disabled when cfg.bulk_rate == 0."""
-        rate = self.cfg.bulk_rate
+        on pass). Disabled when the effective rate is 0."""
+        rate = self._effective_bulk_rate()
         if rate <= 0:
             return False
         if now is None:
@@ -176,11 +235,47 @@ class AdmissionController:
 
     # -- gossip edge --
 
-    def admit_gossip(self, tx: bytes) -> bool:
+    def _peer_rate_exceeded(self, peer_id: str, now: float | None = None) -> bool:
+        """Per-peer token-bucket verdict for ONE gossiped tx (consumes a
+        token on pass). Disabled when cfg.peer_rate == 0. Buckets live in
+        a bounded dict: at peer_max the stalest bucket is evicted, so
+        peer churn cannot grow memory."""
+        rate = self.cfg.peer_rate
+        if rate <= 0:
+            return False
+        if now is None:
+            now = time.monotonic()
+        cap = max(self.cfg.peer_burst, rate, 1.0)
+        with self._mtx:
+            b = self._peer_buckets.get(peer_id)
+            if b is None:
+                if len(self._peer_buckets) >= max(1, self.cfg.peer_max):
+                    stalest = min(self._peer_buckets, key=lambda k: self._peer_buckets[k][1])
+                    del self._peer_buckets[stalest]
+                b = self._peer_buckets[peer_id] = [cap, now]
+            tokens, last = b
+            if now > last:
+                tokens = min(cap, tokens + (now - last) * rate)
+            b[1] = now
+            if tokens >= 1.0:
+                b[0] = tokens - 1.0
+                return False
+            b[0] = tokens
+            return True
+
+    def admit_gossip(self, tx: bytes, peer_id: str | None = None) -> bool:
         """Gate a gossiped tx under pressure: bulk sheds (False, counted),
         priority always passes — the admitted lane's quorums must keep
-        forming, so priority ingest is never paused."""
-        if not self.cfg.enabled or not self.overloaded():
+        forming, so priority ingest is never paused. The per-peer rate
+        bucket is checked FIRST and is lane-blind: one flooding peer must
+        not crowd the shared ingest path, and a hostile peer marking its
+        flood priority must not bypass the cap."""
+        if not self.cfg.enabled:
+            return True
+        if peer_id is not None and self._peer_rate_exceeded(peer_id):
+            self.metrics.rejected_peer.add(1)
+            return False
+        if not self.overloaded():
             return True
         if self.lane_of(tx) == LANE_PRIORITY:
             return True

@@ -440,6 +440,7 @@ _HOT_NOBLOCK_FUNCS = {
     "txflow_tpu/admission/controller.py": {
         "admit_rpc", "admit_gossip", "lane_of", "overloaded",
         "_bulk_shed", "_bulk_rate_exceeded", "forget", "gossip_paused",
+        "_sample_commit_rate", "_effective_bulk_rate", "_peer_rate_exceeded",
     },
 }
 

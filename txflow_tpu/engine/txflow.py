@@ -284,6 +284,10 @@ class TxFlow:
         self._warmer = None
         self._depth_ctrl = None
         self._cold_fallback_votes = 0
+        # last epoch rotation applied by update_state (None = never):
+        # drills assert restaged (no rebuild => no recompile window) and
+        # reconcile dropped/committed counts across nodes
+        self.last_rotation: dict | None = None
 
     # ---- lifecycle (reference OnStart :80-87) ----
 
@@ -1340,29 +1344,60 @@ class TxFlow:
         return self.tx_store.load_tx_commit(tx_hash)
 
     def update_state(self, height: int, val_set: ValidatorSet) -> None:
-        """Block boundary: new height / possibly rotated validator set."""
+        """Block boundary: new height / possibly rotated validator set.
+
+        On a rotated set (epoch boundary: slashing / scheduled join-leave-
+        re-weight), churn safety on the hot path means three things, all
+        done under _mtx so no verify step sees a half-rotated engine:
+
+        1. verifier RESTAGE, not rebuild: the device constants swap in
+           place (same padded shapes, same bucket ladder, same compiled
+           programs, same VerifyCache and warm gate) — zero in-run
+           compiles. Rebuild only when restage is impossible (capacity
+           exceeded by a large join, int32 tally cap, or a non-restagable
+           verifier type).
+        2. every in-flight TxVoteSet is re-evaluated against the new set:
+           votes from removed validators are discarded, sums recomputed
+           at the new powers, and a set that now clears the (possibly
+           lower) quorum commits immediately. Already-latched
+           certificates are immutable (TxVoteSet.revalidate).
+        3. the address->index map swaps with the verifier, so votes
+           prepped after this point gather the new epoch's table rows.
+        """
         with self._mtx:
             self.height = height
             # content comparison, not identity: every block commit hands in
             # a fresh ValidatorSet copy (execution.update_state copies
-            # next_validators), and rebuilding DeviceVoteVerifier — pubkey
-            # decompression + device_put of epoch tables — once per block
-            # would stall the hot vote path for an unchanged set
-            if val_set is not self.val_set and (
-                val_set.hash() != self.val_set.hash()
-            ):
+            # next_validators), and re-staging once per block would churn
+            # device transfers for an unchanged set
+            if val_set is self.val_set or val_set.hash() == self.val_set.hash():
+                return
+            from ..verifier import ResilientVoteVerifier, VerifierMux
+
+            base = self.verifier
+            if isinstance(base, VerifierMux):
+                # a shared mux cannot follow one engine's rotation
+                # (other callers still run the old set): detach to a
+                # private verifier built like the mux's inner one
+                base = base.inner
+            restaged = False
+            rs = getattr(base, "restage", None)
+            if rs is not None:
+                try:
+                    restaged = bool(rs(val_set))
+                except ValueError:
+                    restaged = False  # int32 tally cap: rebuild as scalar
+            if restaged:
+                verifier = base  # same object, new stage — nothing to swap
+                if self._cold_fallback is not None:
+                    # the warm-gate's scalar lane must rotate in lockstep
+                    # (it serves cold shapes with the SAME decisions)
+                    self._cold_fallback.restage(val_set)
+            else:
                 # Build the new verifier BEFORE swapping any engine state so
                 # a constructor failure cannot leave val_set/_addr_to_idx
                 # pointing at the new epoch while the verifier still gathers
                 # the old epoch's tables (wrong results, not an error).
-                from ..verifier import ResilientVoteVerifier, VerifierMux
-
-                base = self.verifier
-                if isinstance(base, VerifierMux):
-                    # a shared mux cannot follow one engine's rotation
-                    # (other callers still run the old set): detach to a
-                    # private verifier built like the mux's inner one
-                    base = base.inner
                 resilient = isinstance(base, ResilientVoteVerifier)
                 if resilient:
                     base = base.device
@@ -1382,21 +1417,50 @@ class TxFlow:
                         verifier = ScalarVoteVerifier(val_set)
                 else:
                     verifier = ScalarVoteVerifier(val_set)
-                self.val_set = val_set
-                self._addr_to_idx = {v.address: i for i, v in enumerate(val_set)}
-                self.verifier = verifier
+            self.val_set = val_set
+            self._addr_to_idx = {v.address: i for i, v in enumerate(val_set)}
+            self.verifier = verifier
+            if not restaged and self._warm_gate is not None:
                 # the shape-stability layer tracks the OLD verifier's
                 # device: rebuild gate/fallback/warmer against the new
                 # epoch (new epoch tables, same bucket ladder — banked
                 # compiles still hit the persistent cache)
-                if self._warm_gate is not None:
-                    if self._warmer is not None:
-                        self._warmer.stop(timeout=0.0)
-                        self._warmer = None
-                    self._shape_registry = None
-                    self._warm_gate = None
-                    self._cold_fallback = None
-                    self._setup_background_warmup()
+                if self._warmer is not None:
+                    self._warmer.stop(timeout=0.0)
+                    self._warmer = None
+                self._shape_registry = None
+                self._warm_gate = None
+                self._cold_fallback = None
+                self._setup_background_warmup()
+            # churn safety: re-evaluate every in-flight quorum against the
+            # new set (removed validators' votes discarded, sums re-weighted,
+            # latched certificates untouched — TxVoteSet.revalidate)
+            dropped = 0
+            newly_quorate = []
+            for vs in list(self.vote_sets.values()):
+                d, quorate = vs.revalidate(val_set)
+                dropped += d
+                if quorate:
+                    newly_quorate.append(vs)
+            for vs in newly_quorate:
+                # a shrinking total power can push a pending tx OVER the
+                # 2n/3 line with no new vote arriving — commit it now, on
+                # the reference-exact inline path (try_add_vote precedent)
+                self._commit_tx(vs)  # txlint: allow(lock-blocking) -- epoch-boundary path (rare, not serving traffic): same reference-exact inline commit the golden scalar path uses
+            self.last_rotation = {
+                "height": height,
+                "restaged": restaged,
+                "votes_dropped": dropped,
+                "commits_on_rotation": len(newly_quorate),
+                "val_set_hash": val_set.hash().hex(),
+            }
+            m = self.metrics
+            m.epoch_rotations.add(1)
+            (m.epoch_restages if restaged else m.epoch_rebuilds).add(1)
+            if dropped:
+                m.epoch_votes_dropped.add(dropped)
+            if newly_quorate:
+                m.epoch_rotation_commits.add(len(newly_quorate))
 
 
 def _hash_key(tx_hash: str) -> bytes:

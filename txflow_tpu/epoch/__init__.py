@@ -1,0 +1,25 @@
+"""Epoch subsystem: deterministic validator-set lifecycle.
+
+The fast path commits a tx the instant accumulated TxVotes cross 2n/3 of
+total stake — which is only safe if every node agrees on *which* stake
+table is in force at every height. This package makes the table a
+deterministic function of the committed chain:
+
+- ``EpochConfig``   — epoch length, slash fraction, scheduled rotation
+  change sets (config.py);
+- ``EpochManager``  — accumulates slashable offenses from committed
+  evidence and, at each epoch boundary block, emits one merged validator
+  change set (slashes + scheduled joins/leaves/re-weights). The change
+  set is injected into the block's persisted EndBlock responses, so the
+  H+2 effect rule, state-store snapshots, and crash-replay all apply it
+  through the exact same code path as app-driven updates (manager.py).
+
+Everything downstream (engine in-flight re-evaluation, verifier
+re-staging) keys off ``Node.update_state`` observing the new set — the
+epoch layer itself never reaches into the hot path.
+"""
+
+from .config import EpochConfig
+from .manager import EpochManager
+
+__all__ = ["EpochConfig", "EpochManager"]

@@ -1,0 +1,44 @@
+"""EpochConfig: validator-set lifecycle tunables.
+
+One dataclass (the AdmissionConfig / HealthConfig pattern) so a node
+assembly, LocalNet, or a drill can swap the whole epoch posture at once.
+Everything here must be identical across nodes — the manager derives
+validator changes purely from (config, committed chain), and any
+divergence would fork the validator set.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class EpochConfig:
+    # blocks per epoch; 0 disables the subsystem entirely (genesis set
+    # stays frozen, evidence keeps stopping at pool admission + gossip)
+    length: int = 0
+
+    # fraction of a slashed validator's power burned per offense epoch.
+    # 1.0 (default) zeroes the offender — power 0 removes it from the
+    # set, Tendermint-style. Partial fractions floor toward zero, so a
+    # repeat offender always reaches removal in finitely many epochs.
+    slash_fraction: float = 1.0
+
+    # scheduled rotation: {epoch_number: [(pub_key_bytes, power), ...]}.
+    # The change set is applied at the boundary block that *ends* that
+    # epoch (height == (epoch_number + 1) * length), taking effect at
+    # boundary + 2 per the H+2 validator-update rule. Power 0 = leave,
+    # new key = join, existing key = re-weight — exactly the
+    # ``ValidatorSet.update_with_change_set`` contract.
+    schedule: dict = field(default_factory=dict)
+
+    def epoch_of(self, height: int) -> int:
+        """Epoch containing ``height`` (0-based; heights start at 1)."""
+        if self.length <= 0 or height <= 0:
+            return 0
+        return (height - 1) // self.length
+
+    def is_boundary(self, height: int) -> bool:
+        """True when ``height`` is the last block of its epoch — the
+        block whose EndBlock carries the epoch's merged change set."""
+        return self.length > 0 and height > 0 and height % self.length == 0

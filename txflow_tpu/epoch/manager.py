@@ -1,0 +1,187 @@
+"""EpochManager: evidence → slashing + scheduled rotation, committed in
+blocks.
+
+Determinism is the whole design. The manager never gossips, never votes,
+and holds no authority of its own: it is a pure-ish fold over the
+committed chain. Every node runs the same fold over the same blocks with
+the same ``EpochConfig``, so every node computes the identical change
+set at the identical boundary height. The change set is handed to
+``BlockExecutor.apply_block`` which *merges it into the block's
+persisted EndBlock validator updates* before ``save_abci_responses`` —
+from there the existing machinery (``update_state`` H+2 rule,
+per-height validator snapshots in the state store, crash-replay via the
+persisted responses) applies it exactly as if the app had asked for it.
+
+Slashing: every committed ``DuplicateBlockVoteEvidence`` marks its
+validator for one offense in the current epoch. At the boundary block
+the offender's power drops to ``floor(power * (1 - slash_fraction))``
+(0 removes). Offenses are deduplicated per (validator, epoch) — ten
+equivocations in one epoch cost the same as one; a fresh offense next
+epoch slashes again from the already-reduced power.
+
+Restart: pending offenses live only in memory, so ``rebuild`` re-folds
+the committed blocks of the current (unfinished) epoch after a crash or
+handshake catch-up. Blocks from *finished* epochs need no replay — their
+boundary change sets are already baked into the persisted responses.
+"""
+
+from __future__ import annotations
+
+from ..analysis.lockgraph import make_lock
+from .config import EpochConfig
+
+
+class EpochManager:
+    def __init__(self, cfg: EpochConfig, metrics=None):
+        self.cfg = cfg
+        self.metrics = metrics
+        self._mtx = make_lock("epoch.EpochManager._mtx")
+        # addr -> first offense height in the current epoch (dedup per
+        # validator per epoch; cleared at each boundary)
+        self._pending: dict[bytes, int] = {}
+        # highest block whose evidence has been folded in (idempotence
+        # guard: apply_block and rebuild may both see a block)
+        self._observed_height = 0
+        # observability (mirrored into txflow_epoch_* gauges by the node)
+        self.slashes_applied = 0
+        self.rotations_applied = 0
+        self.boundaries_crossed = 0
+        self.last_boundary_height = 0
+        self.last_slashed: list[str] = []
+
+    # -- chain fold --
+
+    def end_block_updates(self, block, state, app_updates) -> list:
+        """Called by apply_block for EVERY committed block, in height
+        order. Folds the block's evidence into the pending-offense map;
+        at a boundary height, returns the epoch's merged change set
+        ``[(pub_key, power), ...]`` to append to the block's EndBlock
+        validator updates (empty list off-boundary). ``app_updates`` are
+        the app's own EndBlock updates for this block, needed so slash
+        arithmetic sees the power the update will actually apply to."""
+        if self.cfg.length <= 0:
+            return []
+        with self._mtx:
+            if block.height > self._observed_height:
+                for ev in block.evidence:
+                    self._pending.setdefault(ev.validator_address, block.height)
+                self._observed_height = block.height
+            if not self.cfg.is_boundary(block.height):
+                self._export_pending_locked()
+                return []
+            changes = self._boundary_changes_locked(block.height, state, app_updates)
+            self._pending.clear()
+            self.boundaries_crossed += 1
+            self.last_boundary_height = block.height
+            self._export_pending_locked()
+        return changes
+
+    def _boundary_changes_locked(self, height, state, app_updates) -> list:
+        """Merged change set for the boundary at ``height``: scheduled
+        rotation first (config order), then slashes in address order —
+        so a slash always wins over a same-block scheduled re-weight.
+        Deterministic across nodes by construction."""
+        # the set these updates will be applied to: next_validators plus
+        # the app's own updates from this block (update_with_change_set
+        # applies serially, so slash powers must be computed against the
+        # post-app-update powers to land where intended)
+        working = state.next_validators
+        if app_updates:
+            try:
+                working = working.update_with_change_set(list(app_updates))
+            except ValueError:
+                working = state.next_validators
+        epoch_ending = self.cfg.epoch_of(height)
+        changes: list = []
+        scheduled = self.cfg.schedule.get(epoch_ending, ())
+        for pub_key, power in scheduled:
+            changes.append((pub_key, int(power)))
+        if scheduled:
+            self.rotations_applied += len(scheduled)
+        slashed: list[str] = []
+        for addr in sorted(self._pending):
+            _, val = working.get_by_address(addr)
+            if val is None:
+                continue  # already rotated/slashed out
+            new_power = int(val.voting_power * (1.0 - self.cfg.slash_fraction))
+            changes.append((val.pub_key, max(0, new_power)))
+            slashed.append(addr.hex())
+        if slashed:
+            self.slashes_applied += len(slashed)
+            self.last_slashed = slashed
+            if self.metrics is not None:
+                self.metrics.slashes.add(len(slashed))
+        if scheduled and self.metrics is not None:
+            self.metrics.rotations.add(len(scheduled))
+        return self._sanitize(working, changes)
+
+    @staticmethod
+    def _sanitize(working, changes) -> list:
+        """A change set must never halt block application or empty the
+        validator set (liveness beats punishment). Trial-apply entries
+        serially: a removal that would empty the set degrades to power 1
+        (the offender keeps a token stake until someone else can hold
+        quorum); a removal of an unknown key or a malformed entry is
+        dropped. All nodes fold the same entries in the same order, so
+        the sanitized set is identical everywhere."""
+        from ..crypto.hash import address_hash
+
+        out: list = []
+        cur = working
+        for pub_key, power in changes:
+            try:
+                cur = cur.update_with_change_set([(pub_key, power)])
+                out.append((pub_key, power))
+            except ValueError:
+                if power == 0:
+                    _, val = cur.get_by_address(address_hash(pub_key))
+                    if val is not None:  # empty-set case, not unknown-key
+                        cur = cur.update_with_change_set([(pub_key, 1)])
+                        out.append((pub_key, 1))
+        return out
+
+    # -- restart --
+
+    def rebuild(self, block_store, height: int) -> None:
+        """Re-fold the committed blocks of the current unfinished epoch
+        (boundary+1 .. height) after restart/catch-up, restoring the
+        pending-offense map the crash dropped."""
+        if self.cfg.length <= 0 or height <= 0:
+            return
+        last_boundary = height - (height % self.cfg.length)
+        with self._mtx:
+            self._pending.clear()
+            for h in range(last_boundary + 1, height + 1):
+                block = block_store.load_block(h)
+                if block is None:
+                    continue
+                for ev in block.evidence:
+                    self._pending.setdefault(ev.validator_address, h)
+            self._observed_height = max(self._observed_height, height)
+            self.last_boundary_height = max(
+                self.last_boundary_height, last_boundary
+            )
+            self._export_pending_locked()
+
+    # -- observability --
+
+    def _export_pending_locked(self) -> None:
+        if self.metrics is not None:
+            self.metrics.pending_slashes.set(len(self._pending))
+
+    def snapshot(self) -> dict:
+        """The ``/health`` view: what a slash event looks like from the
+        outside (see README runbook)."""
+        with self._mtx:
+            return {
+                "length": self.cfg.length,
+                "epoch": self.cfg.epoch_of(self._observed_height),
+                "observed_height": self._observed_height,
+                "last_boundary_height": self.last_boundary_height,
+                "boundaries_crossed": self.boundaries_crossed,
+                "pending_slashes": len(self._pending),
+                "pending_addrs": sorted(a.hex() for a in self._pending),
+                "slashes_applied": self.slashes_applied,
+                "rotations_applied": self.rotations_applied,
+                "last_slashed": list(self.last_slashed),
+            }

@@ -19,13 +19,17 @@ first-class, seed-reproducible test input:
   at a failpoint), restart from WAL + stores, and compare replayed state
   (crash.py);
 - ``FlakyVerifier``      — scripted device-verifier failures for exercising
-  ``ResilientVoteVerifier`` degradation (flaky.py).
+  ``ResilientVoteVerifier`` degradation (flaky.py);
+- ``stake``              — seed-deterministic voting-power distributions
+  (uniform / whale / long-tail / churning) + Gini, so weighted-quorum
+  scenarios and bench runs share one generator (stake.py).
 """
 
 from .plan import FaultPlan, FaultSpec
 from .chaos import ChaosRouter
 from .crash import CrashDrill
 from .flaky import FlakyVerifier, InjectedDeviceError
+from .stake import churn_schedule, gini, stake_distribution
 from . import byzantine
 
 __all__ = [
@@ -36,4 +40,7 @@ __all__ = [
     "FlakyVerifier",
     "InjectedDeviceError",
     "byzantine",
+    "stake_distribution",
+    "churn_schedule",
+    "gini",
 ]

@@ -1,0 +1,88 @@
+"""Stake-distribution generator: weighted voting-power shapes for drills
+and bench.
+
+"Weighted Voting on the Blockchain" (arxiv 1903.04213): uniform-stake
+test sets hide the failure modes that matter, because where the 2n/3
+quorum boundary *sits* depends on the shape of the distribution — a
+whale can be a single point of quorum failure, a long tail means many
+small validators are individually irrelevant but collectively decisive.
+Every generator here is seed-deterministic (drills replay exactly) and
+emits small ints (device tallies are int32; keep totals far below 2^30).
+
+Kinds:
+
+- ``uniform``  — every validator holds ``base`` power (the legacy test
+  shape);
+- ``whale``    — one validator holds ~half the total stake, the rest
+  split the remainder evenly: quorum is unreachable without the whale;
+- ``longtail`` — zipf-like power_i ∝ 1/(i+1), deterministically
+  shuffled: a few heavies plus a long tail of minnows;
+- ``churning`` — a longtail base re-drawn per seed, for scenarios that
+  re-weight every epoch (pair with ``churn_schedule``).
+"""
+
+from __future__ import annotations
+
+import random
+
+KINDS = ("uniform", "whale", "longtail", "churning")
+
+
+def stake_distribution(
+    kind: str, n: int, seed: int = 0, base: int = 10
+) -> list[int]:
+    """``n`` voting powers (each >= 1) for the named distribution.
+    Deterministic in (kind, n, seed, base)."""
+    if n <= 0:
+        raise ValueError("n must be positive")
+    if kind == "uniform":
+        return [base] * n
+    rng = random.Random(("stake", kind, n, seed, base).__repr__())
+    if kind == "whale":
+        # one validator at ~half the total: total = 2*base*n, whale takes
+        # base*n, the other n-1 split base*n (min 1 each)
+        if n == 1:
+            return [2 * base]
+        rest = max(1, (base * n) // (n - 1))
+        powers = [rest] * n
+        powers[rng.randrange(n)] = base * n
+        return powers
+    if kind in ("longtail", "churning"):
+        # zipf-ish: power_i ∝ 1/(i+1), scaled so the heaviest holds
+        # ~base*n/2; churning re-draws the shuffle AND jitters weights
+        # per seed so successive epochs genuinely re-weight
+        top = max(2, (base * n) // 2)
+        powers = [max(1, top // (i + 1)) for i in range(n)]
+        if kind == "churning":
+            powers = [max(1, p + rng.randrange(-p // 2 or 1, p // 2 + 1)) for p in powers]
+        rng.shuffle(powers)
+        return powers
+    raise ValueError(f"unknown stake distribution {kind!r} (want {KINDS})")
+
+
+def churn_schedule(
+    pub_keys: list[bytes], n_epochs: int, seed: int = 0, base: int = 10
+) -> dict[int, list[tuple[bytes, int]]]:
+    """An ``EpochConfig.schedule`` that re-weights every validator at
+    every epoch boundary with a fresh ``churning`` draw — the adversarial
+    steady state where no two epochs share a stake table. Deterministic
+    in (pub_keys, n_epochs, seed, base); never removes anyone (powers
+    stay >= 1), so quorum topology questions stay with the drill."""
+    sched: dict[int, list[tuple[bytes, int]]] = {}
+    for e in range(n_epochs):
+        powers = stake_distribution("churning", len(pub_keys), seed=seed + e, base=base)
+        sched[e] = list(zip(pub_keys, powers))
+    return sched
+
+
+def gini(powers) -> float:
+    """Gini coefficient of a power vector (0 = perfectly uniform,
+    → 1 = one validator holds everything). Standard mean-absolute-
+    difference form; O(n^2) is fine at validator-set sizes."""
+    vals = [int(p) for p in powers]
+    n = len(vals)
+    total = sum(vals)
+    if n == 0 or total == 0:
+        return 0.0
+    diff_sum = sum(abs(a - b) for a in vals for b in vals)
+    return diff_sum / (2.0 * n * total)

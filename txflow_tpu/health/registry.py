@@ -35,6 +35,7 @@ class DegradedModeRegistry:
         self._progress: dict = {}
         self._verifier: dict = {}
         self._peers: dict = {}
+        self._epoch: dict = {}
         self._watchdog_state: dict = {"inflight": 0, "oldest_stall_age": 0.0}
         self._healthy = True
 
@@ -141,6 +142,13 @@ class DegradedModeRegistry:
         # the liveness verdict: degraded when the device lane is demoted,
         # a tx has been stalled past ~2 deadlines, or the node has no
         # peers while work is pending
+        # validator-set lifecycle (epoch/): operators read slash events
+        # and the current epoch from /health without scraping /metrics
+        em = getattr(node, "epoch_manager", None)
+        epoch_state = em.snapshot() if em is not None else {}
+        rot = getattr(node.txflow, "last_rotation", None)
+        if rot is not None:
+            epoch_state["last_engine_rotation"] = dict(rot)
         stalled = self._watchdog_state["oldest_stall_age"]
         healthy = (
             (not vstate or vstate["device_healthy"])
@@ -151,6 +159,7 @@ class DegradedModeRegistry:
             self._progress = progress
             self._verifier = vstate
             self._peers = {"n_peers": n_peers}
+            self._epoch = epoch_state
             self._healthy = healthy
         self.metrics.healthy.set(1.0 if healthy else 0.0)
 
@@ -183,4 +192,5 @@ class DegradedModeRegistry:
                 },
                 "verifier": dict(self._verifier),
                 "progress": dict(self._progress),
+                "epoch": dict(self._epoch),
             }

@@ -49,6 +49,8 @@ class LocalNet:
         regossip_interval: float | None = None,
         health: bool = True,
         health_config=None,  # HealthConfig override (health/config.py)
+        voting_powers: list[int] | None = None,  # per-validator stake override
+        epoch_config=None,  # EpochConfig: rotation/slashing (epoch/)
     ):
         """n_nodes: host only the first n_nodes validators as full nodes
         (default: one node per validator). A large validator set does not
@@ -66,10 +68,19 @@ class LocalNet:
                 for i in range(n_validators)
             ]
         self.priv_vals = priv_vals
+        # non-uniform stake (voting_powers, e.g. faults.stake_distribution)
+        # exercises quorum math that uniform powers can never reach: a
+        # whale's single vote can be 1/3+ of the total
+        if voting_powers is not None and len(voting_powers) != len(priv_vals):
+            raise ValueError(
+                f"voting_powers must have {len(priv_vals)} entries, "
+                f"got {len(voting_powers)}"
+            )
+        powers = voting_powers or [voting_power] * len(priv_vals)
         self.val_set = ValidatorSet(
             [
-                Validator.from_pub_key(pv.get_pub_key(), voting_power)
-                for pv in priv_vals
+                Validator.from_pub_key(pv.get_pub_key(), p)
+                for pv, p in zip(priv_vals, powers)
             ]
         )
         cfg = config or test_config()
@@ -118,6 +129,7 @@ class LocalNet:
         self._regossip_interval = regossip_interval
         self._health = health
         self._health_config = health_config
+        self._epoch_config = epoch_config
         self._durable_roots: dict[int, str] = {}
         self._down: set[int] = set()
         hosted = priv_vals if n_nodes is None else priv_vals[:n_nodes]
@@ -172,6 +184,7 @@ class LocalNet:
                 regossip_interval=self._regossip_interval,
                 health=self._health,
                 health_config=self._health_config,
+                epoch_config=self._epoch_config,
             ),
             **dbs,
         )

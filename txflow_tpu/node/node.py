@@ -102,6 +102,10 @@ class NodeConfig:
     pex: bool | None = None
     # address-book persistence path ("" = in-memory only)
     addrbook_path: str = ""
+    # dynamic validator sets (epoch/): scheduled rotation + evidence-
+    # driven slashing at deterministic epoch boundaries. None or
+    # length=0 = static set (seed behavior); see epoch/config.py
+    epoch_config: object = None
 
 
 class Node:
@@ -192,6 +196,19 @@ class Node:
         self.metrics_registry = Registry()
         self.metrics = TxFlowMetrics(self.metrics_registry)
 
+        # -- epoch manager (epoch/): slashing + scheduled rotation folded
+        # into EndBlock validator updates at deterministic boundaries.
+        # Every node runs the same pure fold over the committed chain, so
+        # the derived set is identical everywhere (no gossip, no vote) --
+        self.epoch_manager = None
+        if nc.epoch_config is not None and getattr(nc.epoch_config, "length", 0) > 0:
+            from ..epoch import EpochManager
+            from ..utils.metrics import EpochMetrics
+
+            self.epoch_manager = EpochManager(
+                nc.epoch_config, metrics=EpochMetrics(self.metrics_registry)
+            )
+
         # -- admission front door (admission/): sits between the RPC/
         # gossip edges and the mempool; also supplies the pool's lane
         # classifier so every ingress path lands txs in the right lane --
@@ -204,6 +221,12 @@ class Node:
                 cfg=nc.admission_config,
                 registry=self.metrics_registry,
                 classifier=nc.lane_classifier,
+            )
+            # adaptive bulk rate: the bucket fill tracks the engine's
+            # live commit rate (EWMA * headroom with hysteresis) instead
+            # of the static cfg knob — see controller._sample_commit_rate
+            self.admission.commit_rate_source = (
+                lambda m=self.metrics: m.committed_txs.value()
             )
             self.mempool.lane_of = self.admission.lane_of
             # votes inherit their tx's lane (vote.tx_key -> mempool entry),
@@ -305,6 +328,10 @@ class Node:
             lambda: self.state_view().validators,
             event_bus=self.event_bus,
             db=self._block_db,
+            # epoch-correct admission: verify against the set of the
+            # height the offending vote was cast in (per-height snapshots
+            # persisted by StateStore.save; None falls back to current)
+            val_set_at=lambda h: self.state_store.load_validators(h),
         )
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
         self.switch.add_reactor("evidence", self.evidence_reactor)
@@ -318,6 +345,7 @@ class Node:
             self.commitpool,
             event_bus=self.event_bus,
             evidence_pool=self.evidence_pool,
+            epoch_manager=self.epoch_manager,
         )
         self.consensus: ConsensusState | None = None
         self.consensus_reactor: ConsensusReactor | None = None
@@ -388,6 +416,15 @@ class Node:
         self.txvote_reactor.broadcast_height(height)
         self.mempool_reactor.broadcast_height(height)
         self.evidence_pool.prune(height)
+        if self.epoch_manager is not None:
+            m = self.epoch_manager.metrics
+            if m is not None:
+                cur = self.state_view().validators
+                m.number.set(self.epoch_manager.cfg.epoch_of(height))
+                m.length.set(self.epoch_manager.cfg.length)
+                m.validators.set(cur.size())
+                m.total_power.set(cur.total_voting_power())
+                m.quorum_power.set(cur.quorum_power())
 
     def _on_block_commit(self, new_state, block=None) -> None:
         """Consensus commit hook: sync the fast path to the new height and
@@ -435,6 +472,13 @@ class Node:
             )
             if self.consensus is not None:
                 self.consensus.reset_to_state(new_state)
+        if self.epoch_manager is not None:
+            # refill the pending-offense ledger from committed evidence in
+            # the current (partial) epoch, so a crash between an offense
+            # landing on-chain and its boundary cannot forgive the slash
+            self.epoch_manager.rebuild(
+                self.block_store, self.chain_state.last_block_height
+            )
         self.switch.start()
         self.txflow.start()
         if self.consensus is not None:

@@ -23,9 +23,15 @@ class EvidencePool:
         val_set_provider,  # () -> ValidatorSet for verification
         event_bus: EventBus | None = None,
         db=None,  # durable committed-marker store (shared with BlockStore)
+        val_set_at=None,  # (height) -> ValidatorSet | None: epoch lookup
     ):
         self.chain_id = chain_id
         self._val_set_provider = val_set_provider
+        # epoch-correct admission: evidence must verify against the set
+        # of the epoch the offending vote was CAST in, or a rotated-out
+        # double-signer's proof would bounce (and a fresh joiner could be
+        # framed for pre-join heights). None = legacy current-set check.
+        self._val_set_at = val_set_at
         self.event_bus = event_bus
         self._mtx = threading.Lock()
         self._pending: dict[bytes, object] = {}  # hash -> evidence
@@ -46,7 +52,11 @@ class EvidencePool:
                 return False, None  # known: not an error
         if self._db is not None and self._db.has(b"EV:" + h):
             return False, None  # committed before a restart
-        val_set: ValidatorSet = self._val_set_provider()
+        val_set: ValidatorSet | None = None
+        if self._val_set_at is not None:
+            val_set = self._val_set_at(ev.height())
+        if val_set is None:
+            val_set = self._val_set_provider()
         _, val = val_set.get_by_address(ev.validator_address)
         if val is None:
             return False, "evidence names an unknown validator"

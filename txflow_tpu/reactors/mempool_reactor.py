@@ -130,8 +130,8 @@ class MempoolReactor(Reactor):
             pid = self._peer_id(peer)
             adm = self.admission
             for tx in txs:
-                if adm is not None and not adm.admit_gossip(tx):
-                    continue  # bulk shed before CheckTx under overload
+                if adm is not None and not adm.admit_gossip(tx, peer_id=pid):
+                    continue  # shed before CheckTx: overload or peer cap
                 try:
                     self.mempool.check_tx(tx, TxInfo(sender_id=pid))
                 except ErrTxInCache:

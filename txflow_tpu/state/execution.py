@@ -93,6 +93,7 @@ class BlockExecutor:
         commitpool: Mempool,
         event_bus: EventBus | None = None,
         evidence_pool=None,
+        epoch_manager=None,
     ):
         self.state_store = state_store
         self.proxy_app = proxy_app
@@ -100,12 +101,28 @@ class BlockExecutor:
         self.commitpool = commitpool
         self.event_bus = event_bus
         self.evidence_pool = evidence_pool
+        # epoch lifecycle (epoch.EpochManager | None): folds committed
+        # evidence into slashes and merges the boundary change set into
+        # each boundary block's persisted EndBlock updates (apply_block)
+        self.epoch_manager = epoch_manager
         # optional fast-path hook: predicate(tx) -> bool, True when the
         # fast path owns the tx (proposals then leave it out of block.Txs)
         self.tx_reserved = None
 
     def set_event_bus(self, bus: EventBus) -> None:
         self.event_bus = bus
+
+    def validators_at(self, height: int, state: State) -> ValidatorSet:
+        """The validator set in force at ``height`` — what evidence cast
+        at that height must verify against. With epoch rotation a
+        double-signer may already be slashed OUT of the current set when
+        its proof commits, so checking ``state.validators`` would let the
+        offense expire the moment the offender left (or reject valid
+        proofs about departed validators). The state store persists the
+        per-height snapshots; current validators are the fallback for
+        heights the store doesn't have (fresh chains, pruned windows)."""
+        vals = self.state_store.load_validators(height)
+        return vals if vals is not None else state.validators
 
     # -- proposal (reference CreateProposalBlock :88-109) --
 
@@ -129,7 +146,10 @@ class BlockExecutor:
             for ev in self.evidence_pool.pending():
                 if len(evidence) >= MAX_EVIDENCE_PER_BLOCK:
                     break  # rest waits for the next proposal
-                _, val = state.validators.get_by_address(ev.validator_address)
+                # epoch-correct: verify against the set of the epoch the
+                # offending vote was cast in (validators_at), not today's
+                ev_vals = self.validators_at(ev.height(), state)
+                _, val = ev_vals.get_by_address(ev.validator_address)
                 if (
                     0 < ev.height() <= height
                     and ev.height() > height - MAX_AGE_HEIGHTS
@@ -202,7 +222,11 @@ class BlockExecutor:
                     return "evidence from an impossible height"
                 if ev.height() <= h.height - MAX_AGE_HEIGHTS:
                     return "evidence is too old"
-                _, val = state.validators.get_by_address(ev.validator_address)
+                # the set of the epoch the vote was cast in: a slashed
+                # (already-removed) validator's proof must still verify,
+                # and a new joiner cannot be framed for a pre-join height
+                ev_vals = self.validators_at(ev.height(), state)
+                _, val = ev_vals.get_by_address(ev.validator_address)
                 if val is None:
                     return "evidence names an unknown validator"
                 ev_err = ev.verify(state.chain_id, val.pub_key)
@@ -247,16 +271,36 @@ class BlockExecutor:
 
         failpoints.fail("block-after-exec")
 
-        self.state_store.save_abci_responses(
-            block.height, repr_responses(responses)
-        )
-
         # validator updates from ABCI EndBlock (:146-157)
         val_updates = []
         if responses.end_block is not None:
             val_updates = [
                 (u.pub_key, u.power) for u in responses.end_block.validator_updates
             ]
+
+        if self.epoch_manager is not None:
+            # epoch fold: every block's evidence accumulates; at a boundary
+            # height the merged change set (slashes + scheduled rotation)
+            # comes back and is APPENDED to the EndBlock updates BEFORE the
+            # responses are persisted below — so handshake/catch-up replay
+            # (consensus.replay applies persisted responses directly) and
+            # the live path derive the identical validator set
+            extra = self.epoch_manager.end_block_updates(
+                block, state, val_updates
+            )
+            # merge only when persistable: an applied-but-unpersisted
+            # update would make replay derive a DIFFERENT set (fork)
+            if extra and responses.end_block is not None:
+                from ..abci.types import ValidatorUpdate
+
+                val_updates = val_updates + extra
+                responses.end_block.validator_updates = list(
+                    responses.end_block.validator_updates
+                ) + [ValidatorUpdate(pk, power) for pk, power in extra]
+
+        self.state_store.save_abci_responses(
+            block.height, repr_responses(responses)
+        )
 
         new_state = update_state(state, block_id, block, responses, val_updates)
 

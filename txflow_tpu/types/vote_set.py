@@ -208,6 +208,45 @@ class TxVoteSet:
         if self.val_set.quorum_power() <= self.sum:
             self.maj23 = True
 
+    # ---- validator-set churn (epoch rotation / slashing) ----
+
+    def revalidate(self, new_val_set: ValidatorSet) -> tuple[int, bool]:
+        """Re-evaluate this in-flight set against a NEW validator set
+        (epoch boundary crossed while the tx was below quorum). Returns
+        ``(dropped, newly_quorate)``.
+
+        Semantics, in order of precedence:
+
+        - an already-latched certificate is IMMUTABLE: if maj23 latched
+          under the old set, the set is left byte-identical (the commit
+          it certifies happened under the epoch the votes were cast in)
+          and (0, False) is returned;
+        - votes from validators absent in the new set are discarded —
+          their stake no longer exists, so it must not count toward any
+          future quorum;
+        - surviving votes are re-weighted to their validator's NEW power
+          and ``sum`` recomputed; maj23 latches (returning True) iff the
+          new set's quorum_power is now met — rotation can push a
+          pending tx OVER the line when total power shrank."""
+        with self._mtx:
+            if self.maj23:
+                return 0, False
+            dropped = 0
+            new_sum = 0
+            for addr in list(self.votes):
+                _, val = new_val_set.get_by_address(addr)
+                if val is None:
+                    del self.votes[addr]
+                    dropped += 1
+                else:
+                    new_sum += val.voting_power
+            self.val_set = new_val_set
+            self.sum = new_sum
+            if new_val_set.quorum_power() <= new_sum:
+                self.maj23 = True
+                return dropped, True
+            return dropped, False
+
     # ---- commit construction (reference :242-259) ----
 
     def make_commit(self) -> Commit:

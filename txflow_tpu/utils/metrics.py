@@ -171,8 +171,36 @@ class AdmissionMetrics:
         self.rejected_dup = r.counter("admission", "rejected_dup", "replayed tx bytes rejected by the edge dedup")
         self.rejected_overload = r.counter("admission", "rejected_overload", "bulk txs shed at the RPC edge (429) under overload/headroom")
         self.rejected_gossip = r.counter("admission", "rejected_gossip", "gossiped bulk txs shed before CheckTx under overload")
+        self.rejected_peer = r.counter("admission", "rejected_peer", "gossiped txs shed by the per-peer rate bucket")
         self.overloaded = r.gauge("admission", "overloaded", "1 = pool past high water (hysteresis)")
         self.occupancy = r.gauge("admission", "pool_occupancy", "pool fill fraction at the last pressure poll")
+        # adaptive bulk rate (derived from the engine's live commit rate
+        # with hysteresis; controller._effective_bulk_rate): the gauge is
+        # what the token bucket is actually refilling at RIGHT NOW
+        self.bulk_rate_effective = r.gauge("admission", "bulk_rate_effective", "current bulk token-bucket fill rate (tx/s)")
+        self.commit_rate = r.gauge("admission", "commit_rate_observed", "EWMA of the engine commit rate the bulk bucket tracks (tx/s)")
+
+
+class EpochMetrics:
+    """Validator-set lifecycle metrics (epoch/ subsystem).
+
+    Exposed as ``txflow_epoch_*``. Gauges describe the CURRENT epoch and
+    set (number, size, powers, quorum); counters are monotonic lifecycle
+    events (boundaries crossed, slashes, scheduled rotations). The node
+    refreshes the set gauges on every update_state so a slash is visible
+    the block its power change lands (see README runbook)."""
+
+    def __init__(self, registry: "Registry | None" = None):
+        r = registry or GLOBAL
+        self.number = r.gauge("epoch", "number", "current epoch (0-based)")
+        self.length = r.gauge("epoch", "length_blocks", "blocks per epoch (0 = epochs disabled)")
+        self.validators = r.gauge("epoch", "validators", "validators in the current set")
+        self.total_power = r.gauge("epoch", "total_voting_power", "total stake of the current set")
+        self.quorum_power = r.gauge("epoch", "quorum_power", "2n/3+1 stake threshold of the current set")
+        self.boundaries = r.counter("epoch", "boundaries_total", "epoch boundary blocks committed")
+        self.slashes = r.counter("epoch", "slashes_total", "validators slashed at boundaries")
+        self.rotations = r.counter("epoch", "rotations_total", "scheduled rotation entries applied at boundaries")
+        self.pending_slashes = r.gauge("epoch", "pending_slashes", "offenders awaiting the next boundary")
 
 
 class TxFlowMetrics:
@@ -214,3 +242,12 @@ class TxFlowMetrics:
         # adaptive pipeline depth (engine.adaptive.AdaptiveDepthController)
         self.pipeline_depth_target = r.gauge("txflow", "pipeline_depth_target", "adaptive controller's current depth target")
         self.pipeline_depth_changes = r.counter("txflow", "pipeline_depth_changes", "adaptive depth adjustments applied")
+        # engine-side epoch churn (TxFlow.update_state): a rotation is one
+        # validator-set swap observed by this engine; restages swap device
+        # constants in place (zero recompiles), rebuilds construct a fresh
+        # verifier (capacity exceeded / int32 cap / non-restagable type)
+        self.epoch_rotations = r.counter("epoch", "engine_rotations_total", "validator-set changes applied by the engine")
+        self.epoch_restages = r.counter("epoch", "engine_restages_total", "rotations served by an in-place verifier restage")
+        self.epoch_rebuilds = r.counter("epoch", "engine_rebuilds_total", "rotations that forced a full verifier rebuild")
+        self.epoch_votes_dropped = r.counter("epoch", "engine_votes_dropped_total", "in-flight votes discarded (validator left the set)")
+        self.epoch_rotation_commits = r.counter("epoch", "engine_rotation_commits_total", "txs committed because rotation lowered the quorum")

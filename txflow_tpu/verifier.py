@@ -427,9 +427,25 @@ class ScalarVoteVerifier:
         self.val_set = val_set
         self._pub_keys = [v.pub_key for v in val_set]
         self._powers = val_set.powers_array()
+        # one-tuple epoch stage: verify paths read it ONCE per call so a
+        # concurrent restage() can never mix one epoch's keys with
+        # another's powers (tuple assignment is atomic)
+        self._stage = (val_set, self._pub_keys, self._powers)
         if shared_cache is True:
             shared_cache = VerifyCache()
         self.cache: VerifyCache | None = shared_cache or None
+
+    def restage(self, new_val_set: ValidatorSet) -> bool:
+        """Swap in a new validator set (epoch rotation) in place: no new
+        object, no cache loss. Callers mid-``verify_and_tally`` finish
+        against the stage they grabbed; the next call sees the new set."""
+        pub_keys = [v.pub_key for v in new_val_set]
+        powers = new_val_set.powers_array()
+        self.val_set = new_val_set
+        self._pub_keys = pub_keys
+        self._powers = powers
+        self._stage = (new_val_set, pub_keys, powers)
+        return True
 
     def verify_and_tally(
         self,
@@ -442,13 +458,14 @@ class ScalarVoteVerifier:
         quorum: int | None = None,
     ) -> TallyResult:
         n = len(msgs)
+        val_set, pub_keys, powers = self._stage
         keep = first_occurrence_mask(tx_slot, val_idx)
         valid = np.zeros(n, dtype=bool)
         pending = np.zeros(n, dtype=bool)
         if self.cache is not None:
             keys = [
-                VerifyCache.key(msgs[i], sigs[i], self._pub_keys[int(val_idx[i])])
-                if keep[i] and 0 <= val_idx[i] < len(self._pub_keys)
+                VerifyCache.key(msgs[i], sigs[i], pub_keys[int(val_idx[i])])
+                if keep[i] and 0 <= val_idx[i] < len(pub_keys)
                 else None
                 for i in range(n)
             ]
@@ -475,7 +492,7 @@ class ScalarVoteVerifier:
                             valid[i] = cached[i]
                         else:
                             valid[i] = host_ed.verify(
-                                self._pub_keys[int(val_idx[i])], msgs[i], sigs[i]
+                                pub_keys[int(val_idx[i])], msgs[i], sigs[i]
                             )
                             stores.append((keys[i], bool(valid[i])))
             except BaseException:
@@ -499,8 +516,8 @@ class ScalarVoteVerifier:
         else:
             for i in range(n):
                 vi = int(val_idx[i])
-                if keep[i] and 0 <= vi < len(self._pub_keys):
-                    valid[i] = host_ed.verify(self._pub_keys[vi], msgs[i], sigs[i])
+                if keep[i] and 0 <= vi < len(pub_keys):
+                    valid[i] = host_ed.verify(pub_keys[vi], msgs[i], sigs[i])
         stake = (
             np.zeros(n_slots, dtype=np.int64)
             if prior_stake is None
@@ -509,8 +526,8 @@ class ScalarVoteVerifier:
         for i in range(n):
             s = int(tx_slot[i])
             if valid[i] and 0 <= s < n_slots:
-                stake[s] += int(self._powers[val_idx[i]])
-        q = self.val_set.quorum_power() if quorum is None else quorum
+                stake[s] += int(powers[val_idx[i]])
+        q = val_set.quorum_power() if quorum is None else quorum
         return TallyResult(valid, stake, stake >= q, ~keep | pending)
 
     def submit(
@@ -536,6 +553,34 @@ class ScalarVoteVerifier:
         )
 
 
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, n - 1).bit_length()
+
+
+class _DeviceStage:
+    """One epoch's device constants, bundled so the submit paths read a
+    SINGLE attribute and can never mix one epoch's pubkey tables with
+    another's powers mid-rotation (``self._stage = ...`` is atomic; a
+    batch in flight finishes against the stage it grabbed).
+
+    ``pub_keys``/``val_set`` are the REAL (unpadded) set; ``powers`` /
+    ``tables_dev`` / ``powers_dev`` are padded to the verifier's
+    validator capacity so every epoch of a run shares the exact compiled
+    shapes (restage = two device_puts, zero compiles)."""
+
+    __slots__ = (
+        "val_set", "pub_keys", "epoch", "powers", "tables_dev", "powers_dev"
+    )
+
+    def __init__(self, val_set, pub_keys, epoch, powers, tables_dev, powers_dev):
+        self.val_set = val_set
+        self.pub_keys = pub_keys
+        self.epoch = epoch
+        self.powers = powers
+        self.tables_dev = tables_dev
+        self.powers_dev = powers_dev
+
+
 class DeviceVoteVerifier:
     """Batched device verify + tally behind the same interface.
 
@@ -544,6 +589,12 @@ class DeviceVoteVerifier:
     the curve math and the segment-sum tally run on device. With a mesh,
     the vote axis is sharded and partial stake tallies are psum-combined
     (parallel.mesh.sharded_verify_and_tally).
+
+    Validator-set churn: the per-epoch constants are padded to
+    ``capacity`` (next power of two >= the genesis set size) and bundled
+    in one ``_DeviceStage``; ``restage()`` swaps the bundle in place so
+    an epoch rotation costs two host->device transfers and NO recompile —
+    the bucket ladder is keyed by batch size, never by set identity.
     """
 
     def __init__(
@@ -553,24 +604,12 @@ class DeviceVoteVerifier:
         buckets=DEFAULT_BUCKETS,
         shared_cache: "VerifyCache | bool | None" = None,
     ):
-        self.val_set = val_set
         # cross-engine verify-result sharing (VerifyCache docstring):
         # True = own cache; an instance = share with other verifiers
         if shared_cache is True:
             self.cache: VerifyCache | None = VerifyCache()
         else:
             self.cache = shared_cache or None
-        self._pub_keys = [v.pub_key for v in val_set]
-        self.epoch = ed25519_batch.EpochTables(self._pub_keys)
-        self._powers = val_set.powers_array().astype(np.int32)
-        # int32 device tally: with dedup, per-slot batch stake and prior
-        # stake are each <= total power, so their sum stays < 2^31 only if
-        # total power < 2^30. Larger sets take the scalar (int64) path.
-        if val_set.total_voting_power() >= 2**30:
-            raise ValueError(
-                "total voting power >= 2^30: use ScalarVoteVerifier "
-                "(device tally is int32)"
-            )
         self.buckets = buckets
         # the engine must not drain batches beyond the largest bucket:
         # past it, bucket_size degrades to exact-size rounding and every
@@ -607,24 +646,110 @@ class DeviceVoteVerifier:
         from . import native as _native
 
         _native.available()
-        import jax
 
         if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-
             from .parallel.mesh import sharded_compact_step_packed_cached
 
             self._n_shards = mesh.size
             self._fn = sharded_compact_step_packed_cached(mesh)
-            # pre-replicate the per-epoch device constants across the mesh
-            rep = NamedSharding(mesh, PartitionSpec())
-            self._tables_dev = jax.device_put(self.epoch.tables, rep)
-            self._powers_dev = jax.device_put(self._powers, rep)
         else:
             self._n_shards = 1
             self._fn = tally.compact_step_packed_jit()
-            self._tables_dev = self.epoch.device_tables()
-            self._powers_dev = jax.numpy.asarray(self._powers)
+        # validator capacity: the power-of-two sizes the existing 4/16/64
+        # test and bench configs already compile for are their own pow2,
+        # so padding is free there and gives odd-sized sets in-place
+        # rotation headroom for joins
+        self.capacity = _next_pow2(max(val_set.size(), 4))
+        self._stage = self._build_stage(val_set)
+
+    # -- per-epoch constants (read the stage ONCE per call; see
+    #    _DeviceStage docstring) --
+
+    @property
+    def val_set(self) -> ValidatorSet:
+        return self._stage.val_set
+
+    @property
+    def _pub_keys(self) -> list:
+        return self._stage.pub_keys
+
+    @property
+    def epoch(self):
+        return self._stage.epoch
+
+    @property
+    def _powers(self) -> np.ndarray:
+        return self._stage.powers
+
+    @property
+    def _tables_dev(self):
+        return self._stage.tables_dev
+
+    @property
+    def _powers_dev(self):
+        return self._stage.powers_dev
+
+    def _build_stage(self, val_set: ValidatorSet) -> _DeviceStage:
+        # int32 device tally: with dedup, per-slot batch stake and prior
+        # stake are each <= total power, so their sum stays < 2^31 only if
+        # total power < 2^30. Larger sets take the scalar (int64) path.
+        if val_set.total_voting_power() >= 2**30:
+            raise ValueError(
+                "total voting power >= 2^30: use ScalarVoteVerifier "
+                "(device tally is int32)"
+            )
+        pub_keys = [v.pub_key for v in val_set]
+        pad = self.capacity - len(pub_keys)
+        if pad < 0:
+            raise ValueError(
+                f"validator set of {len(pub_keys)} exceeds staged "
+                f"capacity {self.capacity}"
+            )
+        # pad table rows carry power 0 and an all-zero pubkey (no known
+        # private key), and the engine's address->index map never yields a
+        # pad index — a vote can neither verify against nor draw stake
+        # from the pad range
+        epoch = ed25519_batch.EpochTables(pub_keys + [b"\x00" * 32] * pad)
+        powers = np.zeros(self.capacity, np.int32)
+        powers[: len(pub_keys)] = val_set.powers_array().astype(np.int32)
+        import jax
+
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # pre-replicate the per-epoch device constants across the mesh
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            tables_dev = jax.device_put(epoch.tables, rep)
+            powers_dev = jax.device_put(powers, rep)
+        else:
+            tables_dev = epoch.device_tables()
+            powers_dev = jax.numpy.asarray(powers)
+        return _DeviceStage(val_set, pub_keys, epoch, powers, tables_dev, powers_dev)
+
+    def restage(self, new_val_set: ValidatorSet) -> bool:
+        """Swap the per-epoch device constants for a NEW validator set
+        without recompiling: same padded shapes, same bucket ladder, same
+        VerifyCache, same compiled programs. Returns False when the new
+        set exceeds ``capacity`` — the caller must fall back to building
+        a fresh verifier. Raises ValueError on the int32 tally cap, like
+        construction would. Idempotent for an unchanged set; concurrent
+        submitters finish against whichever stage they grabbed."""
+        if new_val_set.size() > self.capacity:
+            return False
+        old = self._stage
+        if new_val_set.hash() == old.val_set.hash():
+            return True
+        stage = self._build_stage(new_val_set)
+        # the compile contract this subsystem exists to keep: shapes are
+        # a function of capacity + bucket ladder, never of set identity
+        assert stage.tables_dev.shape == old.tables_dev.shape, (
+            "restage changed the staged table shape"
+        )
+        assert stage.powers_dev.shape == old.powers_dev.shape, (
+            "restage changed the staged powers shape"
+        )
+        self._stage = stage
+        return True
 
     def warmup(self, n: int = 1, full: bool = False) -> None:
         """Compile the kernel for the bucket shapes of an n-vote batch.
@@ -717,10 +842,11 @@ class DeviceVoteVerifier:
         val_idx = np.asarray(val_idx, dtype=np.int64)
         tx_slot = np.asarray(tx_slot, dtype=np.int32)
         keep = first_occurrence_mask(tx_slot, val_idx)
+        st = self._stage  # one read: epoch-consistent tables/powers/quorum
         if self.cache is not None:
             return self._submit_cached(
                 msgs, sigs, val_idx, tx_slot, n_slots, prior_stake, quorum,
-                keep,
+                keep, st,
             )
         b = bucket_size(n, self.buckets, multiple=self._n_shards)
         # n_slots is a compiled shape too (prior_stake) — bucket it as well,
@@ -728,7 +854,7 @@ class DeviceVoteVerifier:
         # whole kernel; padding slots receive no votes and slice away
         b_slots = bucket_size(n_slots, self.buckets)
 
-        batch = ed25519_batch.prepare_compact(msgs, sigs, val_idx, self.epoch)
+        batch = ed25519_batch.prepare_compact(msgs, sigs, val_idx, st.epoch)
         batch.pre_ok &= keep
         # pad to bucket: pre_ok False + slot -1 => contributes nothing
         pad = b - n
@@ -744,12 +870,12 @@ class DeviceVoteVerifier:
         prior = np.zeros(b_slots, np.int32)
         if prior_stake is not None:
             prior[:n_slots] = np.asarray(prior_stake, dtype=np.int32)
-        q = np.int32(self.val_set.quorum_power() if quorum is None else quorum)
+        q = np.int32(st.val_set.quorum_power() if quorum is None else quorum)
 
         self.shapes_used.add(("fused", b, b_slots))
         packed = self._fn(
             s_nib, h_nib, vidx, r_y, r_sign, pre_ok, slot,
-            self._tables_dev, self._powers_dev, prior, q,
+            st.tables_dev, st.powers_dev, prior, q,
         )
         # ONE readback — deferred to ticket.result(); per-shard layout
         # [valid b/n | stake S | maj S] (tally.compact_step_packed);
@@ -761,7 +887,7 @@ class DeviceVoteVerifier:
 
     def _submit_cached(
         self, msgs, sigs, val_idx, tx_slot, n_slots, prior_stake, quorum,
-        keep,
+        keep, st: _DeviceStage,
     ) -> VerifyTicket:
         """Cache-aware path: device-verify only the cache misses THIS
         caller claims, tally on the host. Decisions are bit-identical to
@@ -776,9 +902,11 @@ class DeviceVoteVerifier:
         the r5 TPU bench measured 580 votes/s (each engine paying a full
         padded device call for a tiny private miss set) vs 12k uncached."""
         n = len(msgs)
-        n_vals = len(self._powers)
+        # bound on the REAL set (st.powers is padded to capacity; an index
+        # in the pad range must read as unknown-validator, not as a row)
+        n_vals = len(st.pub_keys)
         keys: list[bytes | None] = [
-            VerifyCache.key(msgs[i], sigs[i], self._pub_keys[int(val_idx[i])])
+            VerifyCache.key(msgs[i], sigs[i], st.pub_keys[int(val_idx[i])])
             if keep[i] and 0 <= val_idx[i] < n_vals
             else None
             for i in range(n)
@@ -793,7 +921,7 @@ class DeviceVoteVerifier:
                 miss_idx.append(i)
             else:
                 valid[i] = cached[i]
-        q = self.val_set.quorum_power() if quorum is None else quorum
+        q = st.val_set.quorum_power() if quorum is None else quorum
         if miss_idx:
             miss_keys = [keys[i] for i in miss_idx]
             # keepalive: the device call can exceed the claim TTL by
@@ -811,6 +939,7 @@ class DeviceVoteVerifier:
                     [sigs[i] for i in miss_idx],
                     val_idx[miss_idx],
                     claim_keys=miss_keys,
+                    stage=st,
                 )
             except BaseException:
                 # claims must not outlive a failed dispatch (waiters
@@ -824,7 +953,7 @@ class DeviceVoteVerifier:
             return _CachedDeviceTicket(
                 self.cache, packed, ka, miss_idx, miss_keys, keys,
                 valid, tx_slot, n_slots, prior_stake, q, keep, pending,
-                self._powers, val_idx, self._n_shards, b,
+                st.powers, val_idx, self._n_shards, b,
             )
         # all hits/deferrals: nothing to dispatch — host tally, done now
         stake = (
@@ -834,7 +963,7 @@ class DeviceVoteVerifier:
         )
         ok = valid & (tx_slot >= 0) & (tx_slot < n_slots)
         np.add.at(
-            stake, tx_slot[ok], self._powers[val_idx[ok]].astype(np.int64)
+            stake, tx_slot[ok], st.powers[val_idx[ok]].astype(np.int64)
         )
         return ReadyTicket(
             TallyResult(valid, stake, stake >= q, ~keep | pending)
@@ -873,7 +1002,9 @@ class DeviceVoteVerifier:
             bucket_size(n_slots, self.buckets),
         )]
 
-    def _dispatch_verify_only(self, msgs, sigs, val_idx, claim_keys=None):
+    def _dispatch_verify_only(
+        self, msgs, sigs, val_idx, claim_keys=None, stage=None
+    ):
         """Enqueue the verify-only program; returns (device_array, b)
         without forcing the readback.
 
@@ -885,6 +1016,7 @@ class DeviceVoteVerifier:
         mid-compile hands the same keys to every co-located engine and
         piles N concurrent compiles onto one shape)."""
         n = len(msgs)
+        st = stage if stage is not None else self._stage
         # fine-grained buckets: cached-path miss sets are far smaller than
         # engine drains (other engines own most votes via claims), and
         # padding a ~100-miss set to a 4096-wide program wastes the whole
@@ -894,7 +1026,7 @@ class DeviceVoteVerifier:
         # compiled programs use it, and the tally half of the program is
         # insensitive to slot width next to the verify half
         b_slots = self.buckets[0]
-        batch = ed25519_batch.prepare_compact(msgs, sigs, val_idx, self.epoch)
+        batch = ed25519_batch.prepare_compact(msgs, sigs, val_idx, st.epoch)
         pad = b - n
         self.shapes_used.add(("verify", b, b_slots))
         if claim_keys and self.cache is not None:
@@ -907,8 +1039,8 @@ class DeviceVoteVerifier:
             _pad(batch.r_sign, pad),
             _pad(batch.pre_ok, pad),
             np.full(b, -1, np.int32),
-            self._tables_dev,
-            self._powers_dev,
+            st.tables_dev,
+            st.powers_dev,
             np.zeros(b_slots, np.int32),
             np.int32(1),
         )
@@ -1026,6 +1158,21 @@ class ResilientVoteVerifier:
                 self.device_failures += 1
                 self.last_error = e
             self._mark_device(False)
+
+    def restage(self, new_val_set) -> bool:
+        """Epoch rotation passthrough: restage the device lane in place
+        (keeping its compiled shapes, cache, and the degradation counters
+        here) and mirror the set onto the CPU fallback so a demoted node
+        rotates identically. False = device can't restage (capacity) —
+        the caller rebuilds the whole resilient stack."""
+        rs = getattr(self.device, "restage", None)
+        if rs is None or not rs(new_val_set):
+            return False
+        self.val_set = new_val_set
+        fb = getattr(self.fallback, "restage", None)
+        if fb is not None:
+            fb(new_val_set)
+        return True
 
     def verify_and_tally(
         self,
