@@ -159,6 +159,134 @@ def _force_cpu() -> None:
 BASELINE_VOTES_PER_SEC = 20_000.0  # reference CPU ceiling, BASELINE.md
 
 
+# -- latency-SLO helpers (importable; tests/test_trace.py unit-tests
+# these without running a net) --
+
+
+def lane_quantiles(lat_ms: list) -> dict:
+    """p50/p99/p999 (nearest-rank) of one lane's latency sample."""
+    if not lat_ms:
+        return {"count": 0, "p50_ms": None, "p99_ms": None, "p999_ms": None}
+    s = sorted(lat_ms)
+    def pick(q):
+        return s[min(len(s) - 1, int(q * len(s)))]
+    return {
+        "count": len(s),
+        "p50_ms": round(pick(0.50), 2),
+        "p99_ms": round(pick(0.99), 2),
+        "p999_ms": round(pick(0.999), 2),
+    }
+
+
+def slo_breached(result: dict, budget_ms) -> bool:
+    """Did the run breach the priority-lane p99 budget? A missing lane
+    measurement counts as a breach — the gate must not pass on absent
+    data."""
+    if budget_ms is None:
+        return False
+    p99 = ((result.get("lanes") or {}).get("priority") or {}).get("p99_ms")
+    return p99 is None or p99 > float(budget_ms)
+
+
+def run_latency_slo(platform: str) -> dict:
+    """``--latency-slo``: mixed priority/bulk offered load against a
+    LocalNet with the admission front door's fee-lane classifier active;
+    reports per-lane p50/p99/p999 inject->commit latency plus the
+    host/device critical-path attribution (trace/report.py). Uses the
+    scalar verifier — this mode gates tail latency and attribution, not
+    device throughput — so it runs identically on CPU and TPU hosts."""
+    import statistics as _st  # noqa: F401  (parallel to run_bench imports)
+
+    from txflow_tpu.node import LocalNet
+    from txflow_tpu.trace.report import critical_path, merge_critical_paths
+    from txflow_tpu.utils.config import test_config
+    from txflow_tpu.utils.events import EventTx
+
+    n_vals = int(os.environ.get("BENCH_VALIDATORS", "4"))
+    n_txs = int(os.environ.get("BENCH_SLO_TXS", "256"))
+    prio_frac = float(os.environ.get("BENCH_SLO_PRIORITY_FRAC", "0.25"))
+    pace_tps = float(os.environ.get("BENCH_SLO_PACE_TPS", "200"))
+    cfg = test_config()
+    cfg.mempool.size = max(cfg.mempool.size, 8 * n_txs)
+    cfg.mempool.cache_size = max(cfg.mempool.cache_size, 2 * cfg.mempool.size)
+    cfg.trace.sample_rate = int(os.environ.get("BENCH_SLO_SAMPLE_RATE", "4"))
+    net = LocalNet(
+        n_vals,
+        chain_id="txflow-bench",
+        config=cfg,
+        use_device_verifier=False,
+        index_txs=False,
+    )
+
+    # deterministic lane mix: every ceil(1/frac)-th tx carries a
+    # fee-prefix above the classifier threshold and rides priority
+    stride = max(1, round(1.0 / prio_frac)) if prio_frac > 0 else 0
+    corpus = []  # (tx, is_priority)
+    for i in range(n_txs):
+        if stride and i % stride == 0:
+            corpus.append((b"fee=9;p%d=v" % i, True))
+        else:
+            corpus.append((b"slo-b%d=v" % i, False))
+
+    commit_times = [dict() for _ in net.nodes]
+
+    def make_cb(idx):
+        def cb(ev):
+            commit_times[idx][ev.data.tx_hash] = time.perf_counter()
+        return cb
+
+    for i, node in enumerate(net.nodes):
+        node.event_bus.subscribe_callback(EventTx, make_cb(i))
+
+    net.start()
+    inject_t: dict[str, float] = {}
+    lane_of: dict[str, bool] = {}
+    t0 = time.perf_counter()
+    interval = 1.0 / pace_tps if pace_tps > 0 else 0.0
+    for i, (tx, prio) in enumerate(corpus):
+        if interval:
+            delay = t0 + i * interval - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        tx_hash = hashlib.sha256(tx).hexdigest().upper()
+        node = net.nodes[i % len(net.nodes)]
+        inject_t[tx_hash] = time.perf_counter()
+        lane_of[tx_hash] = prio
+        node.broadcast_tx(tx)
+    ok = net.wait_all_committed([tx for tx, _ in corpus], timeout=300.0)
+    if not ok:
+        raise RuntimeError("timeout waiting for commits")
+
+    lat = {"priority": [], "bulk": []}
+    for times in commit_times:
+        for tx_hash, t_inj in inject_t.items():
+            t_c = times.get(tx_hash)
+            if t_c is not None:
+                lane = "priority" if lane_of[tx_hash] else "bulk"
+                lat[lane].append((t_c - t_inj) * 1e3)
+
+    per_node = [
+        critical_path(n.txflow.pipeline_stats(), n.tracer.digest())
+        for n in net.nodes
+    ]
+    trace_digest = net.nodes[0].tracer.digest()
+    net.stop()
+    return {
+        "metric": "latency_slo",
+        "lanes": {k: lane_quantiles(v) for k, v in lat.items()},
+        "critical_path": merge_critical_paths(per_node),
+        "critical_path_per_node": per_node,
+        "trace_latency_ms": trace_digest.get("latency_ms", {}),
+        "trace_sample_rate": trace_digest.get("sample_rate"),
+        "platform": platform,
+        "validators": n_vals,
+        "nodes": len(commit_times),
+        "txs": n_txs,
+        "priority_frac": prio_frac,
+        "pace_tps": pace_tps,
+    }
+
+
 def run_bench(platform: str) -> dict:
     from txflow_tpu.node import LocalNet
     from txflow_tpu.types import TxVote
@@ -771,6 +899,36 @@ def _no_cache_companion(platform: str) -> dict | None:
 
 def main():
     platform = _resolve_platform()
+    if "--latency-slo" in sys.argv:
+        # tail-latency SLO gate (mirror of --assert-warm's contract: the
+        # result line always prints; the breach exits 3 AFTER it)
+        budget = os.environ.get("BENCH_SLO_P99_MS")
+        if "--slo-p99-ms" in sys.argv:
+            budget = sys.argv[sys.argv.index("--slo-p99-ms") + 1]
+        try:
+            result = run_latency_slo(platform)
+        except Exception as e:
+            result = {
+                "metric": "latency_slo",
+                "error": repr(e)[:300],
+                "platform": platform,
+                "lanes": {},
+            }
+        if budget is not None:
+            result["slo_p99_ms"] = float(budget)
+            result["slo_breach"] = slo_breached(result, budget)
+        print(json.dumps(result))
+        if result.get("slo_breach"):
+            p99 = ((result.get("lanes") or {}).get("priority") or {}).get(
+                "p99_ms"
+            )
+            print(
+                f"bench: --latency-slo failed: priority-lane p99 {p99} ms "
+                f"over budget {budget} ms",
+                file=sys.stderr,
+            )
+            sys.exit(3)
+        return
     try:
         result = run_bench(platform)
         companion = _no_cache_companion(result.get("platform", platform))

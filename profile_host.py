@@ -172,6 +172,19 @@ def main() -> None:
                 f"win_ratio={ad['last_window_ratio']}]"
             )
         print(line)
+    # critical-path attribution (trace/report.py): folds each node's
+    # pipeline accounting + trace digest into host/device/lock-wait/
+    # linger seconds and fractions — the host-bound-or-device-bound
+    # verdict the perf frontiers are steered by
+    from txflow_tpu.trace.report import critical_path, format_line, merge_critical_paths
+
+    cps = [
+        critical_path(s, n.tracer.digest())
+        for s, n in zip(pipe_stats, net.nodes)
+    ]
+    for i, cp in enumerate(cps):
+        print(f"node {i}: {format_line(cp)}")
+    print(f"fleet:  {format_line(merge_critical_paths(cps))}")
 
     _print_hygiene_summary()
 

@@ -297,6 +297,76 @@ def test_unlocked_lru_factory_module_exempt():
 
 
 # ---------------------------------------------------------------------------
+# trace-clock
+# ---------------------------------------------------------------------------
+
+_RAW_CLOCK_SRC = _src("""
+    import time
+
+    def stamp():
+        return time.monotonic()
+""")
+
+
+def test_trace_clock_raw_clock_in_traced_module():
+    active, _ = lint_source(_RAW_CLOCK_SRC, "txflow_tpu/pool/mempool.py")
+    assert _rules(active) == ["trace-clock"]
+    assert "utils.clock" in active[0].message
+
+
+def test_trace_clock_reference_not_just_call():
+    # passing the function as a callback smuggles the raw clock too
+    active, _ = lint_source(_src("""
+        import time
+
+        class C:
+            def __init__(self):
+                self._clock = time.perf_counter
+    """), "txflow_tpu/engine/txflow.py")
+    assert _rules(active) == ["trace-clock"]
+
+
+def test_trace_clock_from_import_flagged():
+    active, _ = lint_source(
+        "from time import monotonic\n", "txflow_tpu/reactors/x.py"
+    )
+    assert _rules(active) == ["trace-clock"]
+
+
+def test_trace_clock_seam_and_sleep_allowed():
+    active, _ = lint_source(_src("""
+        import time
+
+        from ..utils.clock import monotonic
+
+        def pace():
+            t0 = monotonic()
+            time.sleep(0.01)
+            return monotonic() - t0
+    """), "txflow_tpu/trace/tracer.py")
+    assert active == []
+
+
+def test_trace_clock_out_of_scope_exempt():
+    # engine/ is scoped to the ONE traced file; execution.py keeps its
+    # untraced perf_counter accounting, and p2p is outside the scope
+    for path in ("txflow_tpu/engine/execution.py", "txflow_tpu/p2p/switch.py"):
+        active, _ = lint_source(_RAW_CLOCK_SRC, path)
+        assert active == [], path
+
+
+def test_trace_clock_suppression_honored():
+    active, suppressed = lint_source(_src("""
+        import time
+
+        def stamp():
+            return time.time()  # txlint: allow(trace-clock) -- wall stamp for log line only
+    """), "txflow_tpu/admission/controller.py")
+    assert active == []
+    assert _rules(suppressed) == ["trace-clock"]
+
+
+# ---------------------------------------------------------------------------
 # twin-path
 # ---------------------------------------------------------------------------
 

@@ -398,3 +398,53 @@ def test_mempool_check_tx_many_parity():
     # is retryable once the pool drains (cache.remove on reject)
     for pool in (a, b):
         assert _h.sha256(b"bad!").digest() not in pool.cache
+
+
+def test_pool_trace_span_parity():
+    """The twins must also agree on tracing: one accepted item = exactly
+    one ingest span, duplicates and rejections record nothing — whether
+    ingested one-by-one or as a batch, in both pools (sample_rate=1 so
+    every tx is sampled)."""
+    from txflow_tpu.trace.tracer import Tracer
+    from txflow_tpu.utils.config import TraceConfig
+
+    tcfg = TraceConfig(sample_rate=1)
+
+    pv = MockPV()
+    v0, v1 = make_vote(0, pv), make_vote(1, pv)
+    vseq = [v0, v1, v0]  # accept, accept, dup
+
+    def mk_vp():
+        p = TxVotePool(MempoolConfig(size=10, cache_size=100))
+        p.tracer = Tracer(tcfg)
+        return p
+
+    a, b = mk_vp(), mk_vp()
+    _drive_one_by_one(a.check_tx, vseq)
+    b.check_tx_many(vseq)
+    for p in (a, b):
+        names = [s["name"] for s in p.tracer.spans()]
+        assert names == ["vote_ingest", "vote_ingest"]
+        assert p.tracer.open_count() == 0
+    assert [s["tx"] for s in a.tracer.spans()] == [
+        s["tx"] for s in b.tracer.spans()
+    ]
+
+    tseq = [b"a=1", b"b=2", b"a=1"]  # accept, accept, dup
+
+    def mk_mp():
+        p = Mempool(MempoolConfig(size=10, cache_size=100))
+        p.tracer = Tracer(tcfg)
+        return p
+
+    c, d = mk_mp(), mk_mp()
+    _drive_one_by_one(c.check_tx, tseq)
+    d.check_tx_many(tseq)
+    for p in (c, d):
+        names = [s["name"] for s in p.tracer.spans()]
+        assert names == ["mempool_ingest", "mempool_ingest"]
+        # the mempool also anchors the e2e clock at first sight
+        assert len(p.tracer._anchors) == 2
+    assert [s["tx"] for s in c.tracer.spans()] == [
+        s["tx"] for s in d.tracer.spans()
+    ]

@@ -20,8 +20,11 @@ faults active and one node black-holing its gossip mid-run. Asserts the
 admission SLOs: priority-lane p50 commit latency stays within 2x the
 unloaded baseline, every admitted priority tx commits (zero loss),
 evicted peers heal via the address-book re-dial, and shed traffic is
-visible in txflow_admission_* metrics. Exits 1 with a SOAK STALL banner
-on any breach; --overload --smoke is tier-1-budget sized.
+visible in txflow_admission_* metrics. Also records a cross-node trace
+of the run (merged Chrome-trace JSON, SOAK_TRACE_OUT to choose the
+path) and asserts ZERO leaked/unclosed trace spans post-quiescence via
+each node's /health trace digest. Exits 1 with a SOAK STALL banner on
+any breach; --overload --smoke is tier-1-budget sized.
 """
 
 import os
@@ -59,7 +62,13 @@ def overload_main(smoke: bool) -> None:
         sys.exit(1)
 
     overload_secs = 10.0 if smoke else 45.0
-    commit_wait = 30.0 if smoke else 120.0
+    # SOAK_COMMIT_WAIT: like SOAK_P50_BUDGET_MS, a relief valve for
+    # heavily-shared boxes — the post-flood backlog drains at whatever
+    # rate the contended cores allow, and calling slow drain "loss"
+    # turns a capacity statement into a false negative
+    commit_wait = float(
+        os.environ.get("SOAK_COMMIT_WAIT", "30" if smoke else "120")
+    )
     n = 4  # 3-of-4 quorum: commits keep flowing while node 0 black-holes
     net = ProcNet(
         n,
@@ -106,6 +115,9 @@ def overload_main(smoke: bool) -> None:
             # delay compounds straight into the probe p50.)
             "fault": {"drop": 0.02, "delay": 0.02, "delay_max": 0.02, "seed": 7},
             "regossip": 0.2,
+            # dense sampling so the recorded trace has real content at
+            # this run's small tx counts (default 1/64 would be sparse)
+            "trace": {"sample_rate": 4},
             # node 0 black-holes its OUTBOUND gossip mid-overload: its
             # peers see sends-without-progress, evict it by score, and
             # heal through the book re-dial (dials bypass chaos)
@@ -222,7 +234,11 @@ def overload_main(smoke: bool) -> None:
         if not over_lat:
             stall("no priority probes completed under overload")
         p50_over = statistics.median(over_lat)
-        budget = max(2 * p50_base, 0.75)
+        # SOAK_P50_BUDGET_MS: absolute floor for heavily-shared boxes
+        # where 4 processes on contended cores can't hold the 2x-baseline
+        # envelope (the relative SLO still applies when it's larger)
+        floor_s = float(os.environ.get("SOAK_P50_BUDGET_MS", "750")) / 1e3
+        budget = max(2 * p50_base, floor_s)
         print(
             f"priority p50 under overload {p50_over * 1e3:.0f}ms "
             f"(budget {budget * 1e3:.0f}ms, {probe_i} probes)",
@@ -273,6 +289,40 @@ def overload_main(smoke: bool) -> None:
                 f"{len(remaining)}/{len(sample)} admitted bulk txs never "
                 f"committed (admitted-tx loss)"
             )
+
+        # -- trace: record the run + assert zero leaked spans. Every
+        # begin()'d span (device tickets, commit-queue residency) must
+        # have closed once the flood quiesced — an open span here is a
+        # leak, the same class of proof as the drain-on-stop claim
+        # check. Polled briefly: a straggler commit apply may still be
+        # closing its span right at the quiescence edge. --
+        leak_deadline = time.monotonic() + 15.0
+        open_spans = []
+        while True:
+            open_spans = [
+                (net.rpc_json(i, "/health")["result"].get("trace") or {}).get(
+                    "open_spans"
+                )
+                for i in range(n)
+            ]
+            if all(o == 0 for o in open_spans):
+                break
+            if time.monotonic() > leak_deadline:
+                stall(f"leaked trace spans after quiescence: {open_spans}")
+            time.sleep(0.5)
+        dumps = [net.rpc_json(i, "/trace")["result"] for i in range(n)]
+        from txflow_tpu.trace.export import write_chrome_trace
+
+        trace_out = os.environ.get(
+            "SOAK_TRACE_OUT",
+            os.path.join(tempfile.gettempdir(), "soak_overload_trace.json"),
+        )
+        n_spans = write_chrome_trace(trace_out, dumps)
+        print(
+            f"trace: {n_spans} spans from {n} nodes -> {trace_out} "
+            f"(zero open spans on every node)",
+            flush=True,
+        )
         print(
             f"SOAK OK (overload): {overload_secs:.0f}s flood, "
             f"{n_offered} offered / {n_admitted} admitted / {n_shed} shed, "

@@ -1,0 +1,67 @@
+"""Merge node trace dumps into one Chrome-trace / Perfetto JSON file.
+
+Inputs are tracer ``dump()`` payloads — either JSON files written by a
+rig, or live nodes' ``/trace`` RPC endpoints:
+
+    python tools/trace_export.py --out trace.json dump0.json dump1.json
+    python tools/trace_export.py --out trace.json \
+        --rpc 127.0.0.1:26657 --rpc 127.0.0.1:26658
+
+Open the output in https://ui.perfetto.dev or chrome://tracing: one
+process per node, one track per span family in commit-path order, every
+slice tagged with its tx hash (Perfetto query: args.tx) so a single
+transaction can be followed admission -> commit across nodes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def _fetch_rpc(addr: str, timeout: float) -> dict:
+    """One node's /trace payload (RPC replies wrap in {"result": ...})."""
+    url = f"http://{addr}/trace" if "://" not in addr else f"{addr}/trace"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        body = json.load(r)
+    return body.get("result", body)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dumps", nargs="*", help="tracer dump() JSON files")
+    ap.add_argument(
+        "--rpc", action="append", default=[], metavar="HOST:PORT",
+        help="fetch a live node's /trace endpoint (repeatable)",
+    )
+    ap.add_argument("--out", default="trace.json", help="output path")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    from txflow_tpu.trace.export import write_chrome_trace
+
+    dumps: list[dict] = []
+    for path in args.dumps:
+        with open(path) as f:
+            d = json.load(f)
+        dumps.append(d.get("result", d))
+    for addr in args.rpc:
+        dumps.append(_fetch_rpc(addr, args.timeout))
+    if not dumps:
+        ap.error("no inputs: pass dump files and/or --rpc endpoints")
+
+    n = write_chrome_trace(args.out, dumps)
+    open_total = sum(d.get("open_spans", 0) for d in dumps)
+    dropped = sum(d.get("dropped", 0) for d in dumps)
+    print(
+        f"trace_export: {n} spans from {len(dumps)} node(s) -> {args.out} "
+        f"(open={open_total} dropped={dropped}); open in ui.perfetto.dev"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,11 +27,11 @@ is O(1) cache/counter work.
 
 from __future__ import annotations
 
-import time
-
 from ..analysis.lockgraph import make_lock
 from ..pool.mempool import LANE_BULK, LANE_PRIORITY
+from ..trace.tracer import NULL_TRACER, SPAN_ADMISSION
 from ..utils.cache import make_lru
+from ..utils.clock import monotonic
 from ..utils.metrics import AdmissionMetrics
 from .classifier import FeeLaneClassifier
 from .config import AdmissionConfig
@@ -86,6 +86,9 @@ class AdmissionController:
         self._bulk_rate_eff = self.cfg.bulk_rate
         # per-peer gossip buckets: peer_id -> [tokens, last_refill_t]
         self._peer_buckets: dict[str, list] = {}
+        # per-tx tracing (trace/tracer.py): the admission verdict is the
+        # first span on a traced tx's timeline; wired by the node
+        self.tracer = NULL_TRACER
 
     # -- lane classification (mempool.lane_of hook) --
 
@@ -107,7 +110,7 @@ class AdmissionController:
         if not self.cfg.enabled:
             return False
         if now is None:
-            now = time.monotonic()
+            now = monotonic()
         with self._mtx:
             if now < self._next_poll:
                 return self._overloaded
@@ -178,7 +181,7 @@ class AdmissionController:
         if rate <= 0:
             return False
         if now is None:
-            now = time.monotonic()
+            now = monotonic()
         cap = max(self.cfg.bulk_burst, rate, 1.0)
         with self._mtx:
             if self._bulk_refill_t is not None and now > self._bulk_refill_t:
@@ -208,6 +211,9 @@ class AdmissionController:
         """Admit a client-submitted tx (key = sha256(tx)); returns its
         lane. Raises ErrDuplicateTx / ErrOverloaded (see module doc for
         the ordering contract)."""
+        tr = self.tracer
+        traced = tr.active and tr.sampled_key(key)
+        t0 = monotonic() if traced else 0.0
         if not self.cfg.enabled:
             return self.lane_of(tx)
         with self._mtx:
@@ -225,6 +231,8 @@ class AdmissionController:
             self.metrics.admitted_priority.add(1)
         else:
             self.metrics.admitted_bulk.add(1)
+        if traced:
+            tr.span(key.hex().upper(), SPAN_ADMISSION, t0, monotonic())
         return lane
 
     def forget(self, key: bytes) -> None:
@@ -244,7 +252,7 @@ class AdmissionController:
         if rate <= 0:
             return False
         if now is None:
-            now = time.monotonic()
+            now = monotonic()
         cap = max(self.cfg.peer_burst, rate, 1.0)
         with self._mtx:
             b = self._peer_buckets.get(peer_id)

@@ -30,6 +30,7 @@ RULES = {
     "thread-join": "Thread neither daemonized nor joined on a stop()/close() path",
     "hotpath-sync": "host-sync / recompile hazard inside a pipelined engine loop",
     "unlocked-lru": "direct UnlockedLRUCache construction outside utils.cache.make_lru",
+    "trace-clock": "raw time.* timestamp source in a traced hot-path module (use the utils.clock seam)",
     "twin-path": "hand-synced twin changed without its registered parity test",
     "bad-suppression": "txlint suppression without a justification or with an unknown rule",
 }
@@ -137,6 +138,7 @@ def default_passes() -> list[LintPass]:
         _p.ThreadLifecyclePass(),
         _p.HotPathPass(),
         _p.UnlockedLRUPass(),
+        _p.TraceClockPass(),
         TwinPathPass(),
     ]
 

@@ -487,6 +487,76 @@ class HotPathPass(LintPass):
 
 
 # ---------------------------------------------------------------------------
+# trace-clock
+# ---------------------------------------------------------------------------
+
+# traced hot-path scope: every module the per-tx tracer (trace/) stamps
+# spans in. Timestamps here MUST come through the utils.clock seam, or a
+# test that pins the clock sees half the spans on a different timeline
+# and cross-node merge (trace/export.py) loses alignment. engine/ is
+# scoped to the ONE traced file: execution.py keeps perf_counter for its
+# untraced ABCI accounting.
+_TRACE_SCOPE = (
+    "txflow_tpu/engine/txflow.py",
+    "txflow_tpu/trace/",
+    "txflow_tpu/admission/controller.py",
+    "txflow_tpu/pool/",
+    "txflow_tpu/reactors/",
+)
+
+# the forbidden time.* names: every raw timestamp source. time.sleep is
+# fine — pacing isn't a span timestamp.
+_RAW_CLOCK_NAMES = {
+    "time", "time_ns", "monotonic", "monotonic_ns",
+    "perf_counter", "perf_counter_ns",
+}
+
+
+class TraceClockPass(LintPass):
+    """No raw ``time.*`` timestamp source in a traced hot-path module.
+
+    Flags attribute references (not just calls — passing ``time.monotonic``
+    as a callback smuggles the raw clock just as effectively) and
+    ``from time import ...`` of the timestamp names. The seam module
+    itself (utils/clock.py) is outside the scope by construction."""
+
+    name = "trace-clock"
+
+    def run(self, module: ModuleSource) -> list[Violation]:
+        if module.path == "txflow_tpu/utils/clock.py":
+            return []  # the seam wraps the raw clock
+        if not module.path.startswith(_TRACE_SCOPE):
+            return []
+        out: list[Violation] = []
+        for node in ast.walk(module.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "time"
+                and node.attr in _RAW_CLOCK_NAMES
+            ):
+                out.append(
+                    Violation(
+                        self.name, module.path, node.lineno,
+                        f"time.{node.attr} in a traced hot-path module — "
+                        "route through utils.clock so pinned-clock tests and "
+                        "cross-node trace merge stay on one timeline",
+                    )
+                )
+            elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name in _RAW_CLOCK_NAMES:
+                        out.append(
+                            Violation(
+                                self.name, module.path, node.lineno,
+                                f"from time import {a.name} in a traced "
+                                "hot-path module — route through utils.clock",
+                            )
+                        )
+        return out
+
+
+# ---------------------------------------------------------------------------
 # unlocked-lru
 # ---------------------------------------------------------------------------
 

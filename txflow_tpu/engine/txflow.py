@@ -30,17 +30,26 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
-import time
 
 import numpy as np
 
 from ..pool.mempool import Mempool
 from ..pool.txvotepool import TxVotePool
 from ..store.tx_store import TxStore
+from ..trace.tracer import (
+    NULL_TRACER,
+    SPAN_COMMIT,
+    SPAN_DEVICE,
+    SPAN_LINGER,
+    SPAN_LOCK_WAIT,
+    SPAN_PREP,
+    SPAN_QUORUM,
+)
 from ..types import TxVote, TxVoteSet
 from ..types.validator import ValidatorSet
 from ..analysis.lockgraph import make_rlock
 from ..utils.cache import make_lru
+from ..utils.clock import monotonic
 from ..utils.config import EngineConfig
 from ..utils.metrics import TxFlowMetrics
 from ..verifier import DeviceVoteVerifier, ReadyTicket, ScalarVoteVerifier
@@ -59,6 +68,7 @@ class _StepPrep:
     __slots__ = (
         "keys", "votes", "slots", "n_slots", "prior", "msgs", "sigs",
         "val_idx", "dropped", "drain_seq", "verifier", "t0", "submit_t",
+        "trace_txs", "device_sid",
     )
 
     def __init__(self, drain_seq: int, t0: float):
@@ -75,6 +85,12 @@ class _StepPrep:
         self.verifier = None
         self.t0 = t0
         self.submit_t = t0
+        # sampled tx hashes in this batch: batch-level spans (lock_wait,
+        # host_prep, device_verify) are recorded once, tagged with the
+        # first sampled tx, so a traced tx's timeline shows the batch
+        # stages it actually rode through
+        self.trace_txs: list[str] = []
+        self.device_sid = 0
 
 
 class _BatchCoalescer:
@@ -98,11 +114,11 @@ class _BatchCoalescer:
 
     __slots__ = (
         "targets", "linger", "full_batches", "linger_flushes",
-        "_deadline", "_idle", "_clock", "_metrics",
+        "_deadline", "_idle", "_clock", "_metrics", "_tracer", "_hold_t0",
     )
 
     def __init__(self, buckets, cap: int, min_batch: int, linger: float,
-                 metrics=None, clock=time.monotonic):
+                 metrics=None, clock=monotonic, tracer=None):
         targets = sorted(b for b in buckets if min_batch <= b <= cap)
         # no bucket fits the [min_batch, cap] band: degrade to cap-sized
         # dispatches (still one stable shape — cap is the largest bucket)
@@ -114,6 +130,8 @@ class _BatchCoalescer:
         self._idle = False
         self._clock = clock
         self._metrics = metrics
+        self._tracer = tracer or NULL_TRACER
+        self._hold_t0 = 0.0
 
     def decide(self, pending: int) -> int:
         """Votes to dispatch NOW: a full canonical bucket, the whole
@@ -138,12 +156,18 @@ class _BatchCoalescer:
         now = self._clock()
         if self._deadline is None:
             self._deadline = now + self.linger
+            self._hold_t0 = now
         if now >= self._deadline or self._idle:
             self._deadline = None
             self._idle = False
             self.linger_flushes += 1
             if self._metrics is not None:
                 self._metrics.coalesce_linger_flushes.add(1)
+            if self._tracer.active:
+                # batch-level hold: no single tx owns it, so the span is
+                # tagged with the empty tx (report.py attributes linger
+                # from the histogram sum, not per tx)
+                self._tracer.span("", SPAN_LINGER, self._hold_t0, now)
             return pending
         return 0
 
@@ -267,6 +291,14 @@ class TxFlow:
         self._pipe_busy_s = 0.0
         self._pipe_active_s = 0.0
         self._pipe_last_collect = 0.0
+        self._pipe_lock_wait_s = 0.0
+        # per-tx tracing (trace/tracer.py): wired by the node before
+        # start(); NULL_TRACER keeps every hook a no-op attribute check
+        self.tracer = NULL_TRACER
+        # tx_hash -> open commit_apply span id (begun at decision time
+        # under _mtx, finished by whichever path applies: committer
+        # batch, inline effects, late delivery, or a block via claim_vtx)
+        self._commit_spans: dict[str, int] = {}
         # last step's (decided, requeued, dropped) — tests reconcile these
         # against the step() return (decided + dropped; requeued votes are
         # NOT counted: they re-enter via _retry and would double-count)
@@ -336,6 +368,7 @@ class TxFlow:
                     min_batch=self.config.min_batch,
                     linger=self.config.coalesce_linger,
                     metrics=self.metrics,
+                    tracer=self.tracer,
                 )
         if self.config.adaptive_depth and self._depth_ctrl is None:
             from .adaptive import AdaptiveDepthController
@@ -571,6 +604,9 @@ class TxFlow:
                 try:
                     self._route_result(prep, self._collect(prep, ticket))
                 except Exception:
+                    # a failed collect must not leak its open device
+                    # span (no-op when _collect already finished it)
+                    self.tracer.abandon(prep.device_sid)
                     import traceback
 
                     traceback.print_exc()
@@ -585,7 +621,7 @@ class TxFlow:
         min_batch = self.config.min_batch
         if min_batch <= 1:
             return
-        deadline = time.monotonic() + self.config.batch_wait
+        deadline = monotonic() + self.config.batch_wait
         idle_flush = self.config.idle_flush
         while True:
             # unvisited ingest ≈ seq (log end) minus the drain cursor:
@@ -593,7 +629,7 @@ class TxFlow:
             # removed-not-yet-visited entries — a safe coalescing estimate
             seq_now = self.tx_vote_pool.seq()
             pending = seq_now - self._drain_cursor + len(self._retry)
-            remaining = deadline - time.monotonic()
+            remaining = deadline - monotonic()
             if pending >= min_batch or remaining <= 0:
                 return
             # adaptive wait: at light load arrivals come in per-tx bursts
@@ -664,13 +700,19 @@ class TxFlow:
         ``limit`` is the total batch target (retries included) — the
         coalescer passes a canonical bucket size so the dispatched batch
         lands exactly on a prewarmed shape."""
-        t0 = time.perf_counter()
+        t0 = monotonic()
         target = self._drain_cap if limit is None else min(limit, self._drain_cap)
         # seq snapshot BEFORE the drain: the defer-backoff wait must wake
         # for votes that arrive during the verify call, not only after a
         # post-step snapshot
         drain_seq = self.tx_vote_pool.seq()
         with self._mtx:
+            # lock-wait attribution: under contention (consensus-path
+            # claims, inflight_snapshot readers) the gap between t0 and
+            # here is mutex queueing, not host prep — report.py subtracts
+            # it from the host component
+            lk_acq = monotonic()
+            self._pipe_lock_wait_s += lk_acq - t0
             # priority-lane votes first: under overload the main log can
             # be thousands of bulk votes deep, and a priority tx's quorum
             # must not wait out that backlog (admission lanes, ISSUE 6)
@@ -749,6 +791,13 @@ class TxFlow:
             prep.n_slots = n_slots
             prep.prior = prior
 
+            tr = self.tracer
+            if tr.active:
+                # unique txs only (n_slots <= max_slots, not batch size):
+                # one int parse per distinct hash, capped — the overhead
+                # gate in tests/test_trace.py pins this whole path
+                prep.trace_txs = [h for h in slot_of if tr.sampled(h)][:8]
+
             from ..types.tx_vote import sign_bytes_many
 
             prep.msgs = sign_bytes_many(votes, self.chain_id)
@@ -758,10 +807,15 @@ class TxFlow:
                 dtype=np.int64,
             )
             prep.verifier = self.verifier
-        dur = time.perf_counter() - t0
+        end = monotonic()
+        dur = end - t0
         self._pipe_prep_s += dur
         self._pipe_active_s += dur
         self.metrics.pipeline_prep_seconds.add(dur)
+        if prep.trace_txs:
+            tx0 = prep.trace_txs[0]
+            self.tracer.span(tx0, SPAN_LOCK_WAIT, t0, lk_acq)
+            self.tracer.span(tx0, SPAN_PREP, t0, end)
         return prep
 
     def _submit_prep(self, prep: "_StepPrep"):
@@ -777,7 +831,7 @@ class TxFlow:
         pipeline behind a synchronous compile. The BackgroundWarmer
         flips the gate shape by shape; once warm, batches promote to the
         device and never come back."""
-        t0 = time.perf_counter()
+        t0 = monotonic()
         prep.submit_t = t0
         gate = self._warm_gate
         if (
@@ -804,19 +858,29 @@ class TxFlow:
                     prior_stake=prep.prior,
                 )
             )
-        dur = time.perf_counter() - t0
+        dur = monotonic() - t0
         self._pipe_prep_s += dur
         self._pipe_active_s += dur
         self.metrics.pipeline_prep_seconds.add(dur)
+        if prep.trace_txs:
+            # device window is open across the pipelined in-flight gap —
+            # a begin/finish pair so the soak's leak check also proves no
+            # ticket is ever orphaned (the PR 3 drain-on-stop claim)
+            prep.device_sid = self.tracer.begin(
+                prep.trace_txs[0], SPAN_DEVICE, t0
+            )
         return ticket
 
     def _collect(self, prep: "_StepPrep", ticket):
         """Stage 2 collect: block for the ticket's readback and account
         the device-busy window ([submit, collect], unioned across
         overlapping tickets) for the overlap ratio."""
-        t0 = time.perf_counter()
+        t0 = monotonic()
         result = ticket.result()
-        t1 = time.perf_counter()
+        t1 = monotonic()
+        if prep.device_sid:
+            self.tracer.finish(prep.device_sid, t1)
+            prep.device_sid = 0
         self._pipe_wait_s += t1 - t0
         self._pipe_active_s += t1 - t0
         self.metrics.pipeline_wait_seconds.add(t1 - t0)
@@ -838,9 +902,10 @@ class TxFlow:
         order into the authoritative vote sets, committing inline the
         moment a set crosses 2/3. Returns (decided, requeued,
         all_deferred); decided + requeued == len(prep.votes) always."""
-        t0 = time.perf_counter()
+        t0 = monotonic()
         keys, votes = prep.keys, prep.votes
         requeued = 0
+        tr = self.tracer
         # inline-commit decisions made under _mtx; their store/ABCI
         # side-effects run AFTER the lock is released (see below)
         inline_commits: list[tuple[TxVoteSet, list[TxVote], bytes | None]] = []
@@ -882,6 +947,10 @@ class TxFlow:
                 added, err = vs.add_verified_vote(vote)
                 if added:
                     if vs.has_two_thirds_majority():
+                        if tr.active and tr.sampled(vote.tx_hash):
+                            # routing latency up to THIS decision: result
+                            # available (route start) -> quorum latched
+                            tr.span(vote.tx_hash, SPAN_QUORUM, t0, monotonic())
                         if self._committer is not None:
                             self._enqueue_commit(vs)
                         else:
@@ -907,7 +976,7 @@ class TxFlow:
             # bookkeeping walk per commit — r3 step profile: 0.9 ms each)
             self.tx_vote_pool.update(self.height, purge_votes)
 
-        t1 = time.perf_counter()
+        t1 = monotonic()
         self._pipe_route_s += t1 - t0
         self._pipe_active_s += t1 - t0
         self.metrics.pipeline_route_seconds.add(t1 - t0)
@@ -940,6 +1009,7 @@ class TxFlow:
             "prep_s": round(self._pipe_prep_s, 4),
             "dispatch_wait_s": round(self._pipe_wait_s, 4),
             "route_s": round(self._pipe_route_s, 4),
+            "lock_wait_s": round(self._pipe_lock_wait_s, 4),
         }
         co = self._coalescer
         stats["coalesce"] = {
@@ -984,6 +1054,27 @@ class TxFlow:
 
     # ---- commit (reference addVote :216-232) ----
 
+    def _trace_commit_begin(self, tx_hash: str) -> None:
+        """Open the commit_apply span at DECISION time (caller holds
+        _mtx, like the _committed mark it shadows)."""
+        tr = self.tracer
+        if tr.active and tr.sampled(tx_hash):
+            self._commit_spans[tx_hash] = tr.begin(tx_hash, SPAN_COMMIT)
+
+    def _trace_commit_end(self, tx_hash: str) -> None:
+        """Close the commit_apply span from whichever path delivered the
+        apply (committer batch, inline effects, late delivery, block via
+        claim_vtx) and latch the e2e anchor. Safe from any thread; _mtx
+        is reentrant for callers already holding it."""
+        tr = self.tracer
+        if not tr.active:
+            return
+        with self._mtx:
+            sid = self._commit_spans.pop(tx_hash, None)
+        if sid:
+            tr.finish(sid)
+        tr.latch(tx_hash)  # no-op when the tx was never anchored
+
     def _decide_commit(
         self, vs: TxVoteSet
     ) -> tuple[TxVoteSet, list[TxVote], bytes | None]:
@@ -996,6 +1087,7 @@ class TxFlow:
         quorum_votes = vs.get_votes()
         self.vote_sets.pop(vs.tx_hash, None)
         self._committed.push(_hash_key(vs.tx_hash))
+        self._trace_commit_begin(vs.tx_hash)
         tx = self.mempool.get_tx(vs.tx_key)
         if tx is None:
             self._unapplied[vs.tx_hash] = vs.tx_key
@@ -1021,6 +1113,7 @@ class TxFlow:
         self.vote_sets.pop(vs.tx_hash, None)
         self._committed.push(_hash_key(vs.tx_hash))
         self._decided_count += 1
+        self._trace_commit_begin(vs.tx_hash)
         tx = self.mempool.get_tx(vs.tx_key)
         if tx is None:
             # bytes absent at DECISION time: the deferral must be visible
@@ -1077,6 +1170,7 @@ class TxFlow:
                 self.commitpool.check_tx(tx, key=vs.tx_key)
             except Exception:
                 pass  # commitpool dup (e.g. replays) is harmless
+            self._trace_commit_end(vs.tx_hash)
         self.metrics.committed_votes.add(len(quorum_votes))
         if purge_batch is not None:
             purge_batch.extend(quorum_votes)
@@ -1189,6 +1283,8 @@ class TxFlow:
         self.commitpool.push_committed_many(
             [tx for _, tx in apply_items], [vs.tx_key for vs, _ in apply_items]
         )
+        for vs, _tx in apply_items:
+            self._trace_commit_end(vs.tx_hash)
         with self._mtx:  # see the early-return comment above
             self._applied_count += len(items) - deferred - retired
 
@@ -1246,6 +1342,7 @@ class TxFlow:
             self.app_hash = app_hash
             self.metrics.committed_txs.add(1)
             self.commitpool.push_committed_many([tx], [tx_key])
+            self._trace_commit_end(tx_hash)
             with self._mtx:  # racing claim_vtx's locked increment
                 self._applied_count += 1
 
@@ -1320,6 +1417,7 @@ class TxFlow:
                 # tx permanently unapplied on this node)
                 del self._unapplied[tx_hash]
                 self._applied_count += 1  # the block's apply stands in
+                self._trace_commit_end(tx_hash)
                 return True
             if self._committed.__contains__(_hash_key(tx_hash)) or (
                 self.tx_store.has_tx(tx_hash)
@@ -1336,6 +1434,7 @@ class TxFlow:
                 # drain cursor has passed them and no engine commit will
                 # ever purge them now (leak: pool fills, fast path stalls)
                 self.tx_vote_pool.update(self.height, vs.votes_snapshot())
+            self._trace_commit_end(tx_hash)  # block delivery: latch e2e
             return True
 
     # ---- queries (reference LoadCommit :116-120) ----

@@ -307,3 +307,17 @@ class LocalNet:
     def committed_votes_total(self) -> int:
         """Sum over nodes of votes in committed certificates."""
         return sum(int(n.metrics.committed_votes.value()) for n in self.nodes)
+
+    # -- tracing (trace/) --
+
+    def trace_dumps(self) -> list[dict]:
+        """Per-node span-ring dumps (the /trace RPC payload, in-proc)."""
+        return [n.tracer.dump(n.node_id) for n in self.nodes]
+
+    def export_trace(self, path: str) -> int:
+        """Merge every node's span ring into one Chrome-trace JSON file
+        (open in Perfetto / chrome://tracing). Returns the number of
+        span events written."""
+        from ..trace.export import write_chrome_trace
+
+        return write_chrome_trace(path, self.trace_dumps())

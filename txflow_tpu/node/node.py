@@ -196,6 +196,20 @@ class Node:
         self.metrics_registry = Registry()
         self.metrics = TxFlowMetrics(self.metrics_registry)
 
+        # -- per-tx tracing (trace/): ONE tracer per node, attached to
+        # every traced hot-path component below (pools, admission,
+        # engine, gossip reactors). config.trace.enabled=False swaps in
+        # the NullTracer — same surface, zero cost. The commitpool stays
+        # untraced: it re-ingests already-committed txs and would
+        # double-anchor their e2e spans --
+        from ..trace.tracer import make_tracer
+
+        self.tracer = make_tracer(
+            self.config.trace, registry=self.metrics_registry, node_id=node_id
+        )
+        self.mempool.tracer = self.tracer
+        self.tx_vote_pool.tracer = self.tracer
+
         # -- epoch manager (epoch/): slashing + scheduled rotation folded
         # into EndBlock validator updates at deterministic boundaries.
         # Every node runs the same pure fold over the committed chain, so
@@ -228,6 +242,7 @@ class Node:
             self.admission.commit_rate_source = (
                 lambda m=self.metrics: m.committed_txs.value()
             )
+            self.admission.tracer = self.tracer
             self.mempool.lane_of = self.admission.lane_of
             # votes inherit their tx's lane (vote.tx_key -> mempool entry),
             # so the verify engine's priority drain covers the whole
@@ -264,6 +279,9 @@ class Node:
             verifier=verifier,
             metrics=self.metrics,
         )
+        # before txflow.start(): the coalescer built at start() captures
+        # the tracer for its linger spans
+        self.txflow.tracer = self.tracer
 
         # -- switch + reactors (node/node.go:688-722; wiring bug fixed) --
         self.switch = Switch(node_id, node_seed=nc.node_key_seed)
@@ -293,6 +311,8 @@ class Node:
             batch_size=nc.gossip_batch,
             regossip_interval=nc.regossip_interval,
         )
+        self.mempool_reactor.tracer = self.tracer
+        self.txvote_reactor.tracer = self.tracer
         self.switch.add_reactor("mempool", self.mempool_reactor)
         self.switch.add_reactor("txvote", self.txvote_reactor)
 

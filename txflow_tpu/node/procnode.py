@@ -14,6 +14,7 @@ Spec line fields (all optional except index/n/seed_prefix):
      "seed_prefix": "soak1",
      "mempool": {"size": 200},             # MempoolConfig field overrides
      "engine": {"max_batch": 64},          # EngineConfig field overrides
+     "trace": {"sample_rate": 16},         # TraceConfig field overrides
      "admission": {"retry_after": 0.5},    # AdmissionConfig kwargs
      "health": {"score_floor": -4.0},      # HealthConfig kwargs
      "fault": {"drop": 0.02, "seed": 7},   # FaultSpec kwargs (chaos on)
@@ -66,6 +67,8 @@ def main() -> None:
         setattr(config.mempool, k, v)
     for k, v in (spec.get("engine") or {}).items():
         setattr(config.engine, k, v)
+    for k, v in (spec.get("trace") or {}).items():
+        setattr(config.trace, k, v)
 
     admission_config = None
     if spec.get("admission"):

@@ -17,7 +17,9 @@ import threading
 from dataclasses import dataclass, field
 
 from ..crypto.hash import sha256
+from ..trace.tracer import NULL_TRACER, SPAN_TX_INGEST
 from ..utils.cache import make_lru
+from ..utils.clock import monotonic
 from ..utils.config import MempoolConfig
 from ..utils.wal import WAL
 from .base import COMPACT_THRESHOLD, IngestLogPool
@@ -100,6 +102,10 @@ class Mempool(IngestLogPool):
         self._prio_log: list[bytes] = []
         self._prio_log_base = 0  # absolute position of _prio_log[0]
         self._lane_counts = [0, 0]  # live entries per lane (PRIORITY, BULK)
+        # per-tx tracing (trace/tracer.py): the insert is where a tx's
+        # e2e clock starts — wired by the node; NULL_TRACER = one
+        # attribute check per insert
+        self.tracer = NULL_TRACER
         self._txs_available = threading.Event()
         self._notified_txs_available = False
         self._notify_available = False
@@ -291,6 +297,14 @@ class Mempool(IngestLogPool):
         else:
             self._log_append_quiet(key)  # caller notifies per group
         self._txs_bytes += len(tx)
+        tr = self.tracer
+        if tr.active and tr.sampled_key(key):
+            # anchor the e2e span at first local sight of the tx bytes;
+            # the ingest marker makes the insert visible on the timeline
+            t = monotonic()
+            tx_hash = key.hex().upper()
+            tr.anchor(tx_hash, t)
+            tr.span(tx_hash, SPAN_TX_INGEST, t, t)
         if notify:
             self._notify_txs_available()
 

@@ -24,8 +24,10 @@ from dataclasses import dataclass, field
 
 from ..codec import amino
 from ..crypto.hash import sha256
+from ..trace.tracer import NULL_TRACER, SPAN_VOTE_INGEST
 from ..types import TxVote, decode_tx_vote, encode_tx_vote
 from ..utils.cache import make_lru
+from ..utils.clock import monotonic
 from ..utils.config import MempoolConfig
 from ..utils.wal import WAL
 from .base import COMPACT_THRESHOLD, IngestLogPool
@@ -84,6 +86,10 @@ class TxVotePool(IngestLogPool):
         self.lane_of_vote = None
         self._prio_log: list[bytes] = []
         self._prio_log_base = 0  # absolute position of _prio_log[0]
+        # per-tx tracing (trace/tracer.py): vote arrival markers feed the
+        # network-residual attribution; wired by the node, NULL_TRACER =
+        # one attribute check per accepted vote
+        self.tracer = NULL_TRACER
         self.cache = make_lru(config.cache_size)
         self._txs_available = threading.Event()
         self._notified_txs_available = False
@@ -317,6 +323,10 @@ class TxVotePool(IngestLogPool):
                         prio_append(key)
                     self._votes_bytes += vote_size
                     accepted = True
+                    tr = self.tracer
+                    if tr.active and tr.sampled(vote.tx_hash):
+                        t = monotonic()
+                        tr.span(vote.tx_hash, SPAN_VOTE_INGEST, t, t)
                 if accepted:  # an all-dup group must not wake consumers
                     self._log_notify()
                     self._notify_txs_available()
@@ -376,6 +386,10 @@ class TxVotePool(IngestLogPool):
         if lane == LANE_PRIORITY:
             self._prio_log.append(key)
         self._votes_bytes += vote_size
+        tr = self.tracer
+        if tr.active and tr.sampled(vote.tx_hash):
+            t = monotonic()
+            tr.span(vote.tx_hash, SPAN_VOTE_INGEST, t, t)
 
     def _notify_txs_available(self) -> None:
         if self._notify_available and not self._notified_txs_available:

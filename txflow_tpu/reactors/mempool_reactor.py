@@ -15,7 +15,10 @@ from ..analysis.lockgraph import make_lock
 import time
 
 from ..codec import amino
+from ..crypto.hash import sha256
 from ..p2p.base import CHANNEL_MEMPOOL, ChannelDescriptor, Reactor
+from ..trace.tracer import NULL_TRACER, SPAN_GOSSIP_INGEST
+from ..utils.clock import monotonic
 from ..pool.mempool import (
     LANE_PRIORITY,
     ErrMempoolIsFull,
@@ -69,6 +72,9 @@ class MempoolReactor(Reactor):
         # anti-entropy re-walk cadence for lossy links; None = single-pass
         # walk (see TxVoteReactor.regossip_interval for the rationale)
         self.regossip_interval = regossip_interval
+        # per-tx tracing (trace/tracer.py): gossip_ingest spans on the
+        # receive path; wired by the node
+        self.tracer = NULL_TRACER
         self._running = threading.Event()
         self._peer_ids: dict[str, int] = {}
         self._next_peer_id = 1
@@ -129,11 +135,17 @@ class MempoolReactor(Reactor):
             txs = decode_tx_batch(msg[1:])  # decode error -> peer stopped
             pid = self._peer_id(peer)
             adm = self.admission
+            tr = self.tracer
             for tx in txs:
                 if adm is not None and not adm.admit_gossip(tx, peer_id=pid):
                     continue  # shed before CheckTx: overload or peer cap
+                # precomputing the key when tracing feeds both the sample
+                # check and check_tx (which skips its own hash)
+                key = sha256(tx) if tr.active else None
+                traced = tr.active and tr.sampled_key(key)
+                t0 = monotonic() if traced else 0.0
                 try:
-                    self.mempool.check_tx(tx, TxInfo(sender_id=pid))
+                    self.mempool.check_tx(tx, TxInfo(sender_id=pid), key=key)
                 except ErrTxInCache:
                     # dup delivery: feeds the peer's health score
                     # (health/peers.py); gossip redundancy is discounted
@@ -141,6 +153,10 @@ class MempoolReactor(Reactor):
                     continue
                 except (ErrMempoolIsFull, ErrTxTooLarge, ValueError):
                     continue  # app rejection / dup: log-and-ignore (:137)
+                if traced:
+                    tr.span(
+                        key.hex().upper(), SPAN_GOSSIP_INGEST, t0, monotonic()
+                    )
         elif msg_type == MSG_HEIGHT:
             height, _ = amino.read_uvarint(msg, 1)
             peer.set(PEER_HEIGHT_KEY, height)
@@ -153,7 +169,7 @@ class MempoolReactor(Reactor):
         pcursor = 0
         pending: list[tuple[bytes, bytes, int, bool, int]] = []
         seq = self.mempool.seq()
-        last_rewalk = time.monotonic()
+        last_rewalk = monotonic()
         while self._running.is_set() and peer.is_running():
             if not pending:
                 # priority lane first; the bulk walk pauses entirely while
@@ -175,12 +191,12 @@ class MempoolReactor(Reactor):
             if not pending:
                 if (
                     self.regossip_interval is not None
-                    and time.monotonic() - last_rewalk >= self.regossip_interval
+                    and monotonic() - last_rewalk >= self.regossip_interval
                     and self.mempool.size() > 0
                 ):
                     cursor = 0  # anti-entropy re-walk (see __init__)
                     pcursor = 0
-                    last_rewalk = time.monotonic()
+                    last_rewalk = monotonic()
                     continue
                 seq = self.mempool.wait_for_new(seq, timeout=self.poll_interval)
                 continue

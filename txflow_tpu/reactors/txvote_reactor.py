@@ -28,6 +28,8 @@ from dataclasses import dataclass
 from typing import Callable
 
 from ..codec import amino
+from ..trace.tracer import NULL_TRACER, SPAN_SIGN
+from ..utils.clock import monotonic
 from ..p2p.base import CHANNEL_TXVOTE, ChannelDescriptor, Reactor
 from ..pool.mempool import (
     LANE_PRIORITY,
@@ -101,6 +103,9 @@ class TxVoteReactor(Reactor):
         # dedup). None (default) keeps the single-pass walk — in-memory
         # pipes don't lose frames, and the re-walk is pure overhead there.
         self.regossip_interval = regossip_interval
+        # per-tx tracing (trace/tracer.py): the sign walk records a
+        # sign_walk span per sampled tx; wired by the node
+        self.tracer = NULL_TRACER
         self._running = threading.Event()
         self._peer_ids: dict[str, int] = {}  # node_id -> small int (txVotePoolIDs)
         self._next_peer_id = 1
@@ -288,6 +293,7 @@ class TxVoteReactor(Reactor):
             my_addr = self.priv_val.get_address()
             if not st.validators.has_address(my_addr):
                 continue  # keep running: could become a validator any round
+            tr = self.tracer
             for tx_key, tx, _h, fast_path, _lane in items:
                 if not fast_path:
                     # app flagged this tx block-only (e.g. EndBlock-
@@ -295,6 +301,8 @@ class TxVoteReactor(Reactor):
                     # not sign it, so no fast-path quorum can form and
                     # the block path carries it
                     continue
+                traced = tr.active and tr.sampled_key(tx_key)
+                t0 = monotonic() if traced else 0.0
                 # the mempool key IS sha256(tx) — no recompute
                 vote = TxVote(
                     height=st.last_block_height,
@@ -307,6 +315,8 @@ class TxVoteReactor(Reactor):
                     self.tx_vote_pool.check_tx(vote)
                 except (ErrTxInCache, ErrMempoolIsFull, ErrTxTooLarge):
                     continue
+                if traced:
+                    tr.span(vote.tx_hash, SPAN_SIGN, t0, monotonic())
 
     # -- per-peer broadcast (reference :198-265) --
 
@@ -315,7 +325,7 @@ class TxVoteReactor(Reactor):
         cursor = 0
         pending: list[tuple[bytes, TxVote, int, bytes]] = []
         seq = self.tx_vote_pool.seq()
-        last_rewalk = time.monotonic()
+        last_rewalk = monotonic()
         while self._running.is_set() and peer.is_running():
             if not pending:
                 pending, cursor = self.tx_vote_pool.entries_from(
@@ -324,11 +334,11 @@ class TxVoteReactor(Reactor):
             if not pending:
                 if (
                     self.regossip_interval is not None
-                    and time.monotonic() - last_rewalk >= self.regossip_interval
+                    and monotonic() - last_rewalk >= self.regossip_interval
                     and self.tx_vote_pool.size() > 0
                 ):
                     cursor = 0  # anti-entropy re-walk (see __init__)
-                    last_rewalk = time.monotonic()
+                    last_rewalk = monotonic()
                     continue
                 seq = self.tx_vote_pool.wait_for_new(seq, timeout=self.poll_interval)
                 continue

@@ -15,7 +15,8 @@ reference exposes for the fast path, plus the Prometheus text exposition:
 - GET  /validators                              -> current validator set
 - GET  /abci_query?path=P&data=0x..             -> app query
 - GET  /metrics                                 -> Prometheus exposition
-- GET  /health                                  -> {}
+- GET  /health                                  -> degraded-mode + trace digest
+- GET  /trace                                   -> span ring dump (trace/)
 
 Served by a stdlib ThreadingHTTPServer — the runtime dependency story
 stays 'none'; handlers only touch thread-safe node surfaces.
@@ -296,6 +297,7 @@ class RPCServer:
             "/tx_search": self._tx_search,
             "/metrics": self._metrics,
             "/health": self._health,
+            "/trace": self._trace,
             # rpccore.Routes parity (reference node/node.go:898-986)
             "/commit": self._commit,
             "/genesis": self._genesis,
@@ -428,10 +430,29 @@ class RPCServer:
         }
 
     def _health(self, q: dict) -> dict:
-        """Full degraded-mode registry snapshot (health/registry.py); {}
-        when the node runs without a monitor, keeping the probe cheap."""
+        """Full degraded-mode registry snapshot (health/registry.py) plus
+        the trace digest (p50/p99/p999 per span family + leak counters);
+        {} sections when the node runs without a monitor/tracer."""
         mon = getattr(self.node, "health", None)
-        return mon.snapshot() if mon is not None else {}
+        out = dict(mon.snapshot()) if mon is not None else {}
+        tracer = getattr(self.node, "tracer", None)
+        if tracer is not None:
+            out["trace"] = tracer.digest()
+        return out
+
+    def _trace(self, q: dict) -> dict:
+        """Span-ring dump for cross-node merge (tools/trace_export.py,
+        tools/soak.py --overload leak assertion)."""
+        tracer = getattr(self.node, "tracer", None)
+        if tracer is None:
+            return {
+                "node": self.node.node_id,
+                "base_wall_ns": 0,
+                "base_mono": 0.0,
+                "spans": [],
+                "open_spans": 0,
+            }
+        return tracer.dump(self.node.node_id)
 
     def _tx(self, q: dict) -> dict:
         tx_hash = q["hash"].upper()

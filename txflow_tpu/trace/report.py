@@ -1,0 +1,90 @@
+"""Critical-path attribution: where did the wall time go?
+
+Folds one node's engine pipeline accounting (``TxFlow.pipeline_stats``)
+and its trace digest into the host-prep / device / linger / lock-wait /
+network breakdown the ROADMAP's two open perf frontiers are steered by
+(the sim predicts the shared-cache config is HOST-bound; this report is
+what validates or falsifies that on a live run). Wired into
+``profile_host.py`` (per-node lines) and ``bench.py --latency-slo``
+(result-JSON ``critical_path``)."""
+
+from __future__ import annotations
+
+
+def critical_path(pipeline_stats: dict, trace_digest: dict | None = None) -> dict:
+    """One node's attribution: seconds + fractions per component.
+
+    Components: ``host_s`` (batch prep + commit routing, minus lock
+    wait), ``device_s`` (blocked collecting verify tickets), ``lock_-
+    wait_s`` (acquiring the engine mutex), ``linger_s`` (coalescer
+    deadline holds, from the trace histogram sum), ``network_residual_-
+    ms`` (e2e p50 minus the sum of in-node stage p50s: gossip transit +
+    queueing the in-node stages can't see)."""
+    stats = pipeline_stats or {}
+    lat = (trace_digest or {}).get("latency_ms") or {}
+
+    def sum_s(name: str) -> float:
+        return (lat.get(name, {}).get("sum_ms") or 0.0) / 1e3
+
+    lock_wait = stats.get("lock_wait_s", 0.0)
+    prep = stats.get("prep_s", 0.0)
+    route = stats.get("route_s", 0.0)
+    parts = {
+        "host_s": max(0.0, prep - lock_wait) + route,
+        "device_s": stats.get("dispatch_wait_s", 0.0),
+        "lock_wait_s": lock_wait,
+        "linger_s": sum_s("linger"),
+    }
+    busy = sum(parts.values())
+    out = {k: round(v, 4) for k, v in parts.items()}
+    if busy > 0:
+        out["fractions"] = {
+            k.removesuffix("_s"): round(v / busy, 4) for k, v in parts.items()
+        }
+        out["bound"] = max(parts, key=parts.get).removesuffix("_s")
+    # network + cross-stage queueing residual, per sampled tx (p50s)
+    e2e = lat.get("e2e", {}).get("p50")
+    if e2e is not None:
+        stage_sum = sum(
+            lat.get(n, {}).get("p50") or 0.0
+            for n in ("vote_ingest", "host_prep", "device_verify",
+                      "quorum_latch", "commit_apply", "linger")
+        )
+        out["network_residual_ms"] = round(max(0.0, e2e - stage_sum), 3)
+    return out
+
+
+def merge_critical_paths(per_node: list[dict]) -> dict:
+    """Sum the seconds components across nodes, recompute fractions —
+    the fleet-level line bench.py emits."""
+    keys = ("host_s", "device_s", "lock_wait_s", "linger_s")
+    total = {k: round(sum(cp.get(k, 0.0) for cp in per_node), 4) for k in keys}
+    busy = sum(total.values())
+    if busy > 0:
+        total["fractions"] = {
+            k.removesuffix("_s"): round(v / busy, 4) for k, v in total.items()
+            if k in keys
+        }
+        total["bound"] = max(keys, key=lambda k: total[k]).removesuffix("_s")
+    residuals = [
+        cp["network_residual_ms"] for cp in per_node
+        if cp.get("network_residual_ms") is not None
+    ]
+    if residuals:
+        total["network_residual_ms"] = round(
+            sum(residuals) / len(residuals), 3
+        )
+    return total
+
+
+def format_line(cp: dict) -> str:
+    """One-line rendering for profile_host.py."""
+    f = cp.get("fractions") or {}
+    parts = " ".join(
+        f"{k.removesuffix('_s')}={cp.get(k, 0.0):.3f}s({f.get(k.removesuffix('_s'), 0):.0%})"
+        for k in ("host_s", "device_s", "lock_wait_s", "linger_s")
+    )
+    line = f"critical-path: {parts} bound={cp.get('bound', 'n/a')}"
+    if cp.get("network_residual_ms") is not None:
+        line += f" net_residual={cp['network_residual_ms']:.1f}ms"
+    return line

@@ -1,0 +1,340 @@
+"""Per-transaction tracing: bounded span rings + latency histograms.
+
+The commit path is instrumented with named spans (SPAN_* constants
+below) recorded into a per-node preallocated ring buffer. Design
+constraints, in order:
+
+1. **Low overhead, always on.** The sampling decision is one integer
+   xor/mod derived from the tx hash (deterministic across nodes and
+   replays — never Python ``hash()``, which is PYTHONHASHSEED-salted),
+   and a recorded span is one tuple store under a leaf lock. The tier-1
+   overhead gate (tests/test_trace.py) pins the per-vote cost under 3%
+   of a scalar signature verify.
+2. **Deterministic timestamps.** Every clock read routes through the
+   ``utils/clock.py`` monotonic seam, enforced by txlint's
+   ``trace-clock`` pass over the traced modules — replays pin one
+   module and get reproducible spans.
+3. **Zero-cost off switch.** ``TraceConfig(enabled=False)`` yields the
+   ``NullTracer``: every method is a constant-return no-op, no ring, no
+   histograms.
+
+Leak accounting: ``begin()``/``finish()`` pairs (device tickets in
+flight, commit queue residency) are tracked in an open table;
+``open_count()`` must return 0 after quiescence — ``tools/soak.py
+--overload`` asserts this over RPC, the same class of check as the
+PR 3 drain-on-stop claim-leak proof.
+"""
+
+from __future__ import annotations
+
+from ..analysis.lockgraph import make_lock
+from ..utils.clock import monotonic, now_ns
+from ..utils.config import TraceConfig
+from ..utils.metrics import GLOBAL, Registry
+
+# canonical span names, in commit-path order (export assigns one
+# Perfetto track per name, in this order)
+SPAN_ADMISSION = "admission"
+SPAN_TX_INGEST = "mempool_ingest"
+SPAN_GOSSIP_INGEST = "gossip_ingest"
+SPAN_SIGN = "sign_walk"
+SPAN_VOTE_INGEST = "vote_ingest"
+SPAN_LOCK_WAIT = "lock_wait"
+SPAN_LINGER = "linger"
+SPAN_PREP = "host_prep"
+SPAN_DEVICE = "device_verify"
+SPAN_QUORUM = "quorum_latch"
+SPAN_COMMIT = "commit_apply"
+SPAN_E2E = "e2e"
+
+SPAN_ORDER = (
+    SPAN_ADMISSION, SPAN_TX_INGEST, SPAN_GOSSIP_INGEST, SPAN_SIGN,
+    SPAN_VOTE_INGEST, SPAN_LOCK_WAIT, SPAN_LINGER, SPAN_PREP,
+    SPAN_DEVICE, SPAN_QUORUM, SPAN_COMMIT, SPAN_E2E,
+)
+
+
+class NullTracer:
+    """Zero-cost stand-in when tracing is off: same surface, no state."""
+
+    active = False
+
+    def sampled(self, tx_hash) -> bool:
+        return False
+
+    def sampled_key(self, key) -> bool:
+        return False
+
+    def span(self, tx_hash, name, start, end) -> None:
+        pass
+
+    def begin(self, tx_hash, name, start=None) -> int:
+        return 0
+
+    def finish(self, span_id, end=None) -> None:
+        pass
+
+    def abandon(self, span_id) -> None:
+        pass
+
+    def anchor(self, tx_hash, t=None) -> None:
+        pass
+
+    def latch(self, tx_hash, name=SPAN_E2E, t=None) -> None:
+        pass
+
+    def open_count(self) -> int:
+        return 0
+
+    def spans(self) -> list:
+        return []
+
+    def digest(self) -> dict:
+        return {"enabled": False, "open_spans": 0, "recorded": 0, "dropped": 0}
+
+    def dump(self, node_id: str = "") -> dict:
+        return {
+            "node": node_id,
+            "base_wall_ns": 0,
+            "base_mono": 0.0,
+            "spans": [],
+            "open_spans": 0,
+        }
+
+    def reset(self) -> None:
+        pass
+
+
+NULL_TRACER = NullTracer()
+
+# fine-grained low end (sub-ms host stages) up through multi-second
+# commit tails — one ladder for every span family so digests compare
+LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class TraceMetrics:
+    """``txflow_trace_*`` bundle: per-span-name latency histograms plus
+    the recorded/open counters the soak's leak assertion scrapes."""
+
+    def __init__(self, registry: Registry | None = None):
+        r = registry or GLOBAL
+        self._r = r
+        self.spans_recorded = r.counter(
+            "trace", "spans_recorded_total", "trace spans recorded into the ring"
+        )
+        self.open_spans = r.gauge(
+            "trace", "open_spans", "begun spans not yet finished (0 after quiescence)"
+        )
+        self._hists: dict[str, object] = {}
+
+    def observe(self, name: str, duration_s: float) -> None:
+        h = self._hists.get(name)
+        if h is None:
+            # Registry._reg dedupes under its own lock, so a racing first
+            # observe lands on the same Histogram instance
+            h = self._r.histogram(
+                "trace", f"span_{name}_seconds",
+                f"{name} span duration", buckets=LATENCY_BUCKETS,
+            )
+            self._hists[name] = h
+        h.observe(duration_s)
+        self.spans_recorded.add(1)
+
+    def quantiles_ms(self) -> dict[str, dict]:
+        out: dict[str, dict] = {}
+        for name in sorted(self._hists):
+            h = self._hists[name]
+            q = {
+                "p50": h.quantile(0.5),
+                "p99": h.quantile(0.99),
+                "p999": h.quantile(0.999),
+            }
+            out[name] = {
+                k: (round(v * 1e3, 3) if v is not None else None)
+                for k, v in q.items()
+            }
+            out[name]["count"] = h._count
+            out[name]["sum_ms"] = round(h._sum * 1e3, 3)
+        return out
+
+
+class Tracer:
+    """Per-node span recorder. All timestamps are utils.clock.monotonic
+    seconds; ``base_wall_ns``/``base_mono`` anchor them to the wall
+    clock so cross-node merges land on one timeline (export.py)."""
+
+    active = True
+
+    def __init__(
+        self,
+        config: TraceConfig | None = None,
+        registry: Registry | None = None,
+        node_id: str = "",
+    ):
+        cfg = config or TraceConfig()
+        self.sample_rate = max(1, int(cfg.sample_rate))
+        self.seed = int(cfg.seed) & 0xFFFFFFFF
+        self.capacity = max(16, int(cfg.ring_capacity))
+        self.node_id = node_id
+        self._ring: list = [None] * self.capacity
+        self._n = 0  # spans ever recorded; ring index = _n % capacity
+        self._open: dict[int, tuple] = {}
+        self._next_id = 1
+        self._anchors: dict[str, float] = {}
+        self._anchor_cap = 4 * self.capacity
+        self._lk = make_lock("trace.Tracer._lk")
+        self.base_wall_ns = now_ns()
+        self.base_mono = monotonic()
+        self.metrics = TraceMetrics(registry) if registry is not None else None
+
+    # -- sampling (deterministic: same txs on every node / every replay) --
+
+    def sampled(self, tx_hash: str) -> bool:
+        """1-in-sample_rate by the leading 32 bits of the tx hash."""
+        try:
+            v = int(tx_hash[:8], 16)
+        except (ValueError, TypeError):
+            return False
+        return (v ^ self.seed) % self.sample_rate == 0
+
+    def sampled_key(self, key: bytes) -> bool:
+        """Same predicate from the raw digest (key[:4] == hex[:8])."""
+        if len(key) < 4:
+            return False
+        return (int.from_bytes(key[:4], "big") ^ self.seed) % self.sample_rate == 0
+
+    # -- span recording --
+
+    def _record(self, tx_hash: str, name: str, start: float, end: float) -> None:
+        with self._lk:
+            self._ring[self._n % self.capacity] = (tx_hash, name, start, end)
+            self._n += 1
+        if self.metrics is not None:
+            self.metrics.observe(name, max(0.0, end - start))
+
+    def span(self, tx_hash: str, name: str, start: float, end: float) -> None:
+        """Record a complete span (both ends measured by the caller)."""
+        self._record(tx_hash, name, start, end)
+
+    def begin(self, tx_hash: str, name: str, start: float | None = None) -> int:
+        """Open a cross-thread span; returns an id for finish()/abandon().
+        Every begun span must be closed — open_count() is the leak
+        detector the soak asserts against."""
+        t = monotonic() if start is None else start
+        with self._lk:
+            sid = self._next_id
+            self._next_id += 1
+            self._open[sid] = (tx_hash, name, t)
+        return sid
+
+    def finish(self, span_id: int, end: float | None = None) -> None:
+        if not span_id:
+            return
+        t = monotonic() if end is None else end
+        with self._lk:
+            entry = self._open.pop(span_id, None)
+        if entry is not None:
+            self._record(entry[0], entry[1], entry[2], t)
+
+    def abandon(self, span_id: int) -> None:
+        """Close without recording (work shed or superseded mid-span)."""
+        if not span_id:
+            return
+        with self._lk:
+            self._open.pop(span_id, None)
+
+    # -- end-to-end anchoring (first ingest -> commit) --
+
+    def anchor(self, tx_hash: str, t: float | None = None) -> None:
+        """First-seen timestamp for the e2e span (idempotent). Bounded:
+        anchors for txs that never commit (shed, evicted) age out FIFO
+        instead of growing without bound."""
+        tm = monotonic() if t is None else t
+        with self._lk:
+            if tx_hash in self._anchors:
+                return
+            if len(self._anchors) >= self._anchor_cap:
+                self._anchors.pop(next(iter(self._anchors)))
+            self._anchors[tx_hash] = tm
+
+    def latch(self, tx_hash: str, name: str = SPAN_E2E, t: float | None = None) -> None:
+        """Close the anchored span (commit applied). No-op when the
+        anchor aged out or the tx was never anchored."""
+        with self._lk:
+            t0 = self._anchors.pop(tx_hash, None)
+        if t0 is not None:
+            self._record(tx_hash, name, t0, monotonic() if t is None else t)
+
+    # -- introspection --
+
+    def open_count(self) -> int:
+        with self._lk:
+            return len(self._open)
+
+    def spans(self) -> list[dict]:
+        """Ring contents, oldest first, as export-ready dicts."""
+        with self._lk:
+            n = self._n
+            if n <= self.capacity:
+                buf = list(self._ring[:n])
+            else:
+                i = n % self.capacity
+                buf = self._ring[i:] + self._ring[:i]
+        return [
+            {"tx": tx, "name": name, "start": s, "end": e}
+            for (tx, name, s, e) in buf
+        ]
+
+    def dropped(self) -> int:
+        with self._lk:
+            return max(0, self._n - self.capacity)
+
+    def digest(self) -> dict:
+        """p50/p99/p999 per span family + leak counters (/health)."""
+        with self._lk:
+            recorded = self._n
+            open_spans = len(self._open)
+        d = {
+            "enabled": True,
+            "sample_rate": self.sample_rate,
+            "recorded": recorded,
+            "dropped": max(0, recorded - self.capacity),
+            "open_spans": open_spans,
+        }
+        if self.metrics is not None:
+            self.metrics.open_spans.set(open_spans)
+            d["latency_ms"] = self.metrics.quantiles_ms()
+        return d
+
+    def dump(self, node_id: str = "") -> dict:
+        """Everything export/merge needs from one node."""
+        return {
+            "node": node_id or self.node_id,
+            "base_wall_ns": self.base_wall_ns,
+            "base_mono": self.base_mono,
+            "open_spans": self.open_count(),
+            "dropped": self.dropped(),
+            "spans": self.spans(),
+        }
+
+    def reset(self) -> None:
+        with self._lk:
+            self._ring = [None] * self.capacity
+            self._n = 0
+            self._open.clear()
+            self._anchors.clear()
+
+
+def make_tracer(
+    config: TraceConfig | None = None,
+    registry: Registry | None = None,
+    node_id: str = "",
+):
+    """Tracer or NullTracer per config — the ONE construction seam."""
+    cfg = config or TraceConfig()
+    if not getattr(cfg, "enabled", True):
+        return NULL_TRACER
+    return Tracer(cfg, registry=registry, node_id=node_id)

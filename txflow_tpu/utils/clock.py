@@ -11,6 +11,13 @@ This module is the one sanctioned source: consensus code imports
 ``now_ns``/``now`` from here, tests monkeypatch here, and the lint pass
 whitelists calls routed through these names. Keep it free of any other
 dependency — it is imported by the lowest layers.
+
+The monotonic seams below exist for the tracing subsystem (trace/):
+every timestamp that can land in a trace span must come from here, so a
+replay can pin ONE module and get deterministic spans, and so txlint's
+``trace-clock`` pass can forbid raw ``time.monotonic``/``perf_counter``
+in the traced hot-path modules without whitelisting call sites one by
+one.
 """
 
 from __future__ import annotations
@@ -26,3 +33,23 @@ def now_ns() -> int:
 def now() -> float:
     """Wall-clock seconds."""
     return time.time()
+
+
+def monotonic() -> float:
+    """Monotonic seconds (deadlines, linger windows, trace spans)."""
+    return time.monotonic()
+
+
+def monotonic_ns() -> int:
+    """Monotonic nanoseconds."""
+    return time.monotonic_ns()
+
+
+def perf_counter() -> float:
+    """High-resolution monotonic seconds (stage timing, trace spans)."""
+    return time.perf_counter()
+
+
+def perf_counter_ns() -> int:
+    """High-resolution monotonic nanoseconds."""
+    return time.perf_counter_ns()

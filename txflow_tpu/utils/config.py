@@ -169,6 +169,22 @@ class EngineConfig:
 
 
 @dataclass
+class TraceConfig:
+    """Per-transaction tracing (trace/tracer.py; default-ON).
+
+    ``sample_rate`` is 1-in-N txs by hash (deterministic across nodes
+    and replays; 1 = trace every tx). ``enabled=False`` swaps in the
+    zero-cost NullTracer — no ring, no histograms, no sampling checks
+    beyond one attribute read. ``ring_capacity`` bounds the per-node
+    span ring; old spans are overwritten (counted as dropped)."""
+
+    enabled: bool = True
+    sample_rate: int = 64
+    seed: int = 0
+    ring_capacity: int = 8192
+
+
+@dataclass
 class Config:
     chain_id: str = "txflow-chain"
     root_dir: str = ""
@@ -178,6 +194,7 @@ class Config:
     rpc: RPCConfig = field(default_factory=RPCConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
+    trace: TraceConfig = field(default_factory=TraceConfig)
 
     def to_dict(self) -> dict:
         return asdict(self)

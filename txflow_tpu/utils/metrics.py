@@ -18,6 +18,17 @@ class _Metric:
         self.help = help_
         self._mtx = threading.Lock()
 
+    def _header(self, kind: str) -> str:
+        # HELP before TYPE, help text with newlines/backslashes escaped
+        # per the exposition-format spec — scrapers (and our own
+        # parse_exposition) reject a bare newline inside a comment
+        lines = []
+        if self.help:
+            esc = self.help.replace("\\", "\\\\").replace("\n", "\\n")
+            lines.append(f"# HELP {self.name} {esc}")
+        lines.append(f"# TYPE {self.name} {kind}")
+        return "\n".join(lines) + "\n"
+
 
 class Gauge(_Metric):
     def __init__(self, name: str, help_: str = ""):
@@ -37,7 +48,7 @@ class Gauge(_Metric):
             return self._v
 
     def expose(self) -> str:
-        return f"# TYPE {self.name} gauge\n{self.name} {self.value()}\n"
+        return self._header("gauge") + f"{self.name} {self.value()}\n"
 
 
 class Counter(_Metric):
@@ -54,7 +65,7 @@ class Counter(_Metric):
             return self._v
 
     def expose(self) -> str:
-        return f"# TYPE {self.name} counter\n{self.name} {self.value()}\n"
+        return self._header("counter") + f"{self.name} {self.value()}\n"
 
 
 class Histogram(_Metric):
@@ -81,7 +92,7 @@ class Histogram(_Metric):
 
     def expose(self) -> str:
         with self._mtx:
-            lines = [f"# TYPE {self.name} histogram"]
+            lines = [self._header("histogram").rstrip("\n")]
             cum = 0
             for i, b in enumerate(self.buckets):
                 cum += self._counts[i]
@@ -91,6 +102,27 @@ class Histogram(_Metric):
             lines.append(f"{self.name}_sum {self._sum}")
             lines.append(f"{self.name}_count {self._count}")
             return "\n".join(lines) + "\n"
+
+    def quantile(self, q: float) -> float | None:
+        """Estimated q-quantile (0 < q < 1) by linear interpolation
+        inside the owning bucket — the standard histogram_quantile
+        estimate, so a /health digest and a PromQL dashboard agree.
+        None when empty; observations past the last finite bucket clamp
+        to that bucket's upper bound (+Inf has no midpoint to guess)."""
+        with self._mtx:
+            if self._count == 0:
+                return None
+            rank = q * self._count
+            cum = 0
+            lo = 0.0
+            for i, b in enumerate(self.buckets):
+                prev = cum
+                cum += self._counts[i]
+                if cum >= rank:
+                    frac = (rank - prev) / max(self._counts[i], 1)
+                    return lo + (b - lo) * frac
+                lo = b
+            return float(self.buckets[-1]) if self.buckets else None
 
 
 class Registry:
@@ -123,6 +155,83 @@ class Registry:
 
 
 GLOBAL = Registry()
+
+
+def parse_exposition(text: str) -> dict[str, dict]:
+    """Parse a Prometheus text exposition back into per-family dicts.
+
+    Scrape-compliance oracle for the tests (and the soak's metric
+    assertions): every family maps to ``{"type": ..., "help": ...,
+    "samples": {sample_name_or_(name, labels): value}}``. Histogram
+    families additionally get ``"buckets"``: an ordered
+    ``[(le_string, cumulative_count), ...]`` ending at ``+Inf``.
+    Raises ValueError on lines a Prometheus scraper would reject."""
+    families: dict[str, dict] = {}
+
+    def family_of(sample_name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = sample_name.removesuffix(suffix)
+            if base != sample_name and base in families:
+                return base
+        return sample_name
+
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                raise ValueError(f"malformed comment line: {raw!r}")
+            fam = families.setdefault(
+                parts[2], {"type": None, "help": "", "samples": {}, "buckets": []}
+            )
+            if parts[1] == "TYPE":
+                fam["type"] = parts[3] if len(parts) > 3 else "untyped"
+            else:
+                fam["help"] = parts[3] if len(parts) > 3 else ""
+            continue
+        # sample line: name[{labels}] value
+        name_part, _, value_part = line.rpartition(" ")
+        if not name_part:
+            raise ValueError(f"malformed sample line: {raw!r}")
+        value = float(value_part)  # ValueError on garbage
+        labels = ""
+        name = name_part
+        if "{" in name_part:
+            name, _, rest = name_part.partition("{")
+            labels, close, trailer = rest.partition("}")
+            if not close or trailer.strip():
+                raise ValueError(f"malformed labels: {raw!r}")
+        fam = families.setdefault(
+            family_of(name), {"type": None, "help": "", "samples": {}, "buckets": []}
+        )
+        key = name if not labels else (name, labels)
+        fam["samples"][key] = value
+        if name.endswith("_bucket"):
+            le = None
+            for pair in labels.split(","):
+                k, _, v = pair.partition("=")
+                if k.strip() == "le":
+                    le = v.strip().strip('"')
+            if le is None:
+                raise ValueError(f"histogram bucket without le label: {raw!r}")
+            fam["buckets"].append((le, value))
+    # structural checks a scraper enforces on histograms
+    for base, fam in families.items():
+        if fam["type"] != "histogram":
+            continue
+        buckets = fam["buckets"]
+        if not buckets or buckets[-1][0] != "+Inf":
+            raise ValueError(f"{base}: histogram missing +Inf bucket")
+        counts = [c for _, c in buckets]
+        if counts != sorted(counts):
+            raise ValueError(f"{base}: bucket counts not cumulative")
+        if fam["samples"].get(base + "_count") != buckets[-1][1]:
+            raise ValueError(f"{base}: _count != +Inf cumulative count")
+        if base + "_sum" not in fam["samples"]:
+            raise ValueError(f"{base}: missing _sum")
+    return families
 
 
 class HealthMetrics:
