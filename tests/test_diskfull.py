@@ -1,0 +1,155 @@
+"""Disk-full / EIO drills (utils/failpoints.py): a node whose durable
+writes start failing DEGRADES — keeps committing in memory, flips the
+storage section of /health, sheds bulk load at the admission edge — and
+never crashes or silently drops an admitted tx.
+
+Failpoints are process-global and STICKY once fired: every test disarms
+in a finally block BEFORE tearing the net down, or unrelated tests
+inherit the armed point.
+"""
+
+import hashlib
+import time
+
+import pytest
+
+from txflow_tpu.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    ErrOverloaded,
+)
+from txflow_tpu.node.localnet import LocalNet
+from txflow_tpu.pool.mempool import LANE_BULK, LANE_PRIORITY, Mempool
+from txflow_tpu.utils import failpoints
+from txflow_tpu.utils.config import MempoolConfig
+
+
+def _single_node_net(tmp_path):
+    """One validator (power 10 >= quorum 7) commits solo — the smallest
+    rig whose commit path still exercises every durable write."""
+    net = LocalNet(1, use_device_verifier=False, enable_consensus=False)
+    net.make_durable(0, str(tmp_path / "node0"))
+    return net
+
+
+def _wait(pred, timeout=20.0, poll=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    return pred()
+
+
+def test_filedb_enospc_degrades_not_crashes(tmp_path):
+    net = _single_node_net(tmp_path)
+    net.start()
+    try:
+        node = net.nodes[0]
+        # healthy baseline: durable commits land
+        first = [b"fee=1;pre-%d=v" % i for i in range(5)]
+        for tx in first:
+            net.broadcast_tx(tx)
+        assert net.wait_all_committed(first)
+        assert not node.txflow.storage_degraded
+
+        # disk fills: every subsequent FileDB append raises ENOSPC
+        failpoints.arm("filedb.append", after=0)
+        second = [b"fee=1;post-%d=v" % i for i in range(5)]
+        for tx in second:
+            net.broadcast_tx(tx)
+        want = [hashlib.sha256(t).hexdigest().upper() for t in second]
+        # the node keeps DECIDING: commits apply in memory even though
+        # the certificate rows can't be persisted
+        assert _wait(
+            lambda: all(node.txflow.is_tx_committed(h) for h in want)
+        ), "node stopped committing when its disk filled"
+        assert _wait(lambda: node.txflow.storage_degraded)
+        assert node.txflow.storage_errors > 0
+        assert node.txflow.storage_last_error
+        # loud degradation, machine-readable: metrics + /health
+        assert "txflow_storage_errors" in node.metrics_registry.expose()
+        reg = node.health.registry
+        reg.refresh(node)
+        snap = reg.snapshot()
+        assert snap["storage"]["degraded"]
+        assert snap["storage"]["errors"] > 0
+        assert not snap["healthy"]
+        # the admission edge sheds bulk while storage is degraded (the
+        # node-wired degraded_source hook)
+        assert node.admission._storage_degraded()
+        with pytest.raises(ErrOverloaded):
+            node.admission.admit_rpc(b"shedme=v", hashlib.sha256(b"shedme=v").digest())
+    finally:
+        failpoints.disarm(None)
+        net.stop()
+
+
+def test_wal_eio_degrades_pools_not_drops(tmp_path):
+    net = _single_node_net(tmp_path)
+    net.start()
+    try:
+        node = net.nodes[0]
+        warm = [b"fee=1;warm-%d=v" % i for i in range(3)]
+        for tx in warm:
+            net.broadcast_tx(tx)
+        assert net.wait_all_committed(warm)
+        assert not node.mempool.wal_degraded
+
+        # WAL device starts erroring (EIO): admitted txs must still flow
+        # to commit — the WAL is a restart-recovery aid, not the
+        # admission ledger
+        failpoints.arm("wal.write", after=0)
+        after = [b"fee=1;eio-%d=v" % i for i in range(3)]
+        for tx in after:
+            net.broadcast_tx(tx)
+        assert net.wait_all_committed(after), "tx dropped when the WAL went EIO"
+        assert _wait(lambda: node.mempool.wal_degraded)
+        reg = node.health.registry
+        reg.refresh(node)
+        snap = reg.snapshot()
+        assert snap["storage"]["mempool_wal_degraded"]
+        assert not snap["healthy"]
+    finally:
+        failpoints.disarm(None)
+        net.stop()
+
+
+def test_degraded_source_sheds_bulk_spares_priority():
+    """Unit: the degraded_source hook makes _bulk_shed fire — bulk txs
+    get ErrOverloaded at the RPC edge, priority txs still land."""
+    from txflow_tpu.utils.metrics import Registry
+
+    pool = Mempool(MempoolConfig(cache_size=100))
+    # isolated registry: the module-level GLOBAL one is shared by every
+    # controller built without an explicit registry, and other tests
+    # assert absolute counter values on it
+    adm = AdmissionController(pool, cfg=AdmissionConfig(), registry=Registry())
+    pool.lane_of = adm.lane_of
+    key = lambda tx: hashlib.sha256(tx).digest()
+
+    assert adm.admit_rpc(b"b0=v", key(b"b0=v"), now=1000.0) == LANE_BULK
+    adm.degraded_source = lambda: True
+    with pytest.raises(ErrOverloaded):
+        adm.admit_rpc(b"b1=v", key(b"b1=v"), now=1000.0)
+    assert adm.admit_rpc(b"fee=2;p0=v", key(b"fee=2;p0=v"), now=1000.0) == LANE_PRIORITY
+    # a faulting source must fail open, not error the admit path
+    adm.degraded_source = lambda: 1 / 0
+    assert adm.admit_rpc(b"b1=v", key(b"b1=v"), now=1000.0) == LANE_BULK
+
+
+def test_failpoint_is_sticky_until_disarmed():
+    try:
+        failpoints.arm("filedb.append", after=2)
+        for _ in range(2):
+            failpoints.fail("filedb.append")  # under the threshold
+        with pytest.raises(failpoints.FailpointError):
+            failpoints.fail("filedb.append")
+        # sticky: keeps failing until disarmed
+        with pytest.raises(failpoints.FailpointError):
+            failpoints.fail("filedb.append")
+        assert failpoints.fired("filedb.append")
+        failpoints.disarm("filedb.append")
+        failpoints.fail("filedb.append")  # no raise
+    finally:
+        failpoints.disarm(None)

@@ -367,6 +367,41 @@ def test_votepool_lane_eviction_parity():
         assert [k for k, _v, _h, _s in items] == [vote_key(prio)]
 
 
+def test_votepool_wal_degradation_parity(tmp_path):
+    """WAL EIO through both twins (drift alarm for the degrade branch):
+    a failing WAL append must not raise out of either ingest path, must
+    flip wal_degraded identically, and the votes must still land — the
+    WAL is a restart-recovery aid, not the admission ledger."""
+    from txflow_tpu.utils import failpoints
+
+    pv = MockPV()
+    votes = [make_vote(i, pv) for i in range(4)]
+
+    def mk(name):
+        p = TxVotePool(MempoolConfig(size=10, cache_size=100))
+        p.init_wal(str(tmp_path / name))
+        return p
+
+    a, b = mk("one"), mk("many")
+    try:
+        failpoints.arm("wal.write", after=0)
+        errs_one = _drive_one_by_one(a.check_tx, votes)
+        errs_many = b.check_tx_many(votes)
+    finally:
+        failpoints.disarm(None)
+    assert [type(e) for e in errs_one] == [type(e) for e in errs_many]
+    assert all(e is None for e in errs_one)
+    for p in (a, b):
+        assert p.wal_degraded
+        assert p.wal_errors >= 1
+        assert p.size() == 4
+        for v in votes:
+            assert p.has(vote_key(v))
+    assert [v.signature for _, v in a.entries()] == [
+        v.signature for _, v in b.entries()
+    ]
+
+
 def test_mempool_check_tx_many_parity():
     """Mempool twin of the votepool parity test: dup, byte-budget full,
     pre_check rejection, and size-cap full must come out of check_tx and

@@ -1,0 +1,470 @@
+"""Catch-up sync (txflow_tpu/sync/): wiped, lagging, and freshly-joined
+nodes recover committed state from peers — under fire.
+
+Covers the ISSUE 9 acceptance drills:
+
+- LocalNet node wiped mid-run under chaos (gossip AND sync channels
+  intercepted) rejoins via sync and converges to byte-identical
+  certificate rows within the FaultSpec liveness budget;
+- a Byzantine sync server feeding forged certificates / wrong epoch
+  snapshots / truncated ranges is detected, scored down, banned, and
+  rotated away from without poisoning the recovering node's state;
+- graceful degradation to the fallback state when no peer can serve;
+- wire codec roundtrips and TxStore ranged-read primitives.
+"""
+
+import hashlib
+import os
+import time
+
+from txflow_tpu.faults.plan import FaultSpec, GOSSIP_CHANNELS, SYNC_CHANNELS
+from txflow_tpu.node.localnet import LocalNet
+from txflow_tpu.store.db import MemDB
+from txflow_tpu.store.tx_store import TxStore
+from txflow_tpu.sync import wire
+from txflow_tpu.sync.config import SyncConfig
+from txflow_tpu.types import MockPV, TxVote, TxVoteSet, Validator, ValidatorSet
+
+
+# -- helpers --
+
+
+def _fast_sync_cfg(**kw) -> SyncConfig:
+    base = dict(
+        poll_interval=0.05,
+        status_interval=0.1,
+        request_timeout=1.0,
+        backoff_base=0.05,
+        backoff_cap=0.5,
+        fallback_cooldown=0.5,
+        byzantine_ban=60.0,
+    )
+    base.update(kw)
+    return SyncConfig(**base)
+
+
+def _commit_set(net, txs, node_index=0, timeout=60):
+    for tx in txs:
+        net.broadcast_tx(tx, node_index=node_index)
+    assert net.wait_all_committed(txs, timeout=timeout)
+
+
+def _wait_has_all(node, hashes, timeout):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(node.tx_store.has_tx(h) for h in hashes):
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def _mkvote(pv, chain_id, tx):
+    key = hashlib.sha256(tx).digest()
+    v = TxVote(
+        height=0,
+        tx_hash=key.hex().upper(),
+        tx_key=key,
+        validator_address=pv.get_address(),
+    )
+    pv.sign_tx_vote(chain_id, v)
+    return v
+
+
+# -- wire codec --
+
+
+def test_wire_status_roundtrip():
+    frame = wire.encode_status(12345, 67)
+    assert frame[0] == wire.MSG_STATUS
+    assert wire.decode_status(frame) == (12345, 67)
+
+
+def test_wire_range_req_roundtrip():
+    frame = wire.encode_range_req(9, 1024, 64)
+    assert frame[0] == wire.MSG_RANGE_REQ
+    assert wire.decode_range_req(frame) == (9, 1024, 64)
+
+
+def test_wire_range_resp_roundtrip():
+    pvs = [MockPV(hashlib.sha256(b"wirev%d" % i).digest()) for i in range(3)]
+    vals = ValidatorSet(
+        [Validator.from_pub_key(pv.get_pub_key(), 10) for pv in pvs]
+    )
+    entries = [
+        ("AA" * 32, b"cert-blob-1", b"tx-bytes-1"),
+        ("BB" * 32, b"cert-blob-2", b""),
+    ]
+    frame = wire.encode_range_resp(7, 100, 250, entries, {0: vals})
+    req_id, start, advert, got, snaps = wire.decode_range_resp(frame)
+    assert (req_id, start, advert) == (7, 100, 250)
+    assert got == entries
+    assert list(snaps) == [0]
+    assert [(v.address, v.voting_power) for v in snaps[0]] == [
+        (v.address, v.voting_power) for v in vals
+    ]
+
+
+# -- TxStore ranged reads + tx-bytes rows --
+
+
+def test_tx_store_ranged_reads():
+    pv = MockPV(hashlib.sha256(b"storev").digest())
+    vals = ValidatorSet([Validator.from_pub_key(pv.get_pub_key(), 10)])
+    store = TxStore(MemDB())
+    hashes = []
+    for i in range(5):
+        tx = b"range-%d=v" % i
+        v = _mkvote(pv, "store-chain", tx)
+        vs = TxVoteSet("store-chain", 0, v.tx_hash, v.tx_key, vals)
+        vs.add_verified_vote(v)
+        store.save_tx(vs, votes=[v], tx=tx)
+        hashes.append(v.tx_hash)
+    assert store.seq_count() == 5
+    got = store.committed_range(0, 5)
+    assert [h for _seq, h in got] == hashes
+    assert [s for s, _h in got] == list(range(5))
+    # partial windows clamp
+    assert [h for _s, h in store.committed_range(3, 10)] == hashes[3:]
+    assert store.committed_range(5, 10) == []
+    # raw cert row + tx bytes roundtrip, byte-identical re-save
+    for i, h in enumerate(hashes):
+        cert = store.load_cert_row(h)
+        assert cert is not None
+        tx = store.load_tx_bytes(h)
+        assert tx == b"range-%d=v" % i
+    assert store.load_cert_row("CC" * 32) is None
+    assert store.load_tx_bytes("CC" * 32) is None
+
+
+# -- the tier-1 wipe-and-rejoin drill (chaos on gossip AND sync) --
+
+
+def test_wipe_and_rejoin_under_chaos(tmp_path):
+    spec = FaultSpec(
+        seed=21,
+        drop=0.05,
+        delay=0.1,
+        delay_max=0.01,
+        channels=GOSSIP_CHANNELS | SYNC_CHANNELS,
+        liveness_budget=60.0,
+    )
+    net = LocalNet(
+        4,
+        use_device_verifier=False,
+        enable_consensus=False,
+        fault_plan=spec,
+        regossip_interval=0.2,
+        sync_config=_fast_sync_cfg(),
+    )
+    net.make_durable(3, str(tmp_path / "node3"))
+    net.start()
+    try:
+        first = [b"fee=1;wipe-%d=v" % i for i in range(30)]
+        _commit_set(net, first, timeout=spec.liveness_budget)
+        net.crash_node(3)
+        net.wipe_node(3)
+        assert os.listdir(tmp_path / "node3") == []  # really wiped
+        # the flood continues while node 3 is gone — it must catch up on
+        # txs it never saw, not just replay what it had
+        second = [b"fee=1;late-%d=v" % i for i in range(15)]
+        for tx in second:
+            net.broadcast_tx(tx, node_index=1)
+        # wait for the live quorum to commit the late batch before the
+        # revive (wait_all_committed would poll the dead node), so the
+        # wiped node recovers the whole set via sync instead of racing
+        # in-flight votes into natively-latched certificates
+        late_hashes = [hashlib.sha256(t).hexdigest().upper() for t in second]
+        for i in (0, 1, 2):
+            assert _wait_has_all(
+                net.nodes[i], late_hashes, spec.liveness_budget
+            ), f"live node {i} never committed the late batch"
+        node3 = net.revive_node(3)
+        want = [
+            hashlib.sha256(t).hexdigest().upper() for t in first + second
+        ]
+        assert _wait_has_all(node3, want, spec.liveness_budget), (
+            f"wiped node did not converge within the liveness budget: "
+            f"{node3.sync_manager.snapshot()}"
+        )
+        # byte-identical certificates: each recovered H: row must equal
+        # some live peer's row exactly (re-save is deterministic; under
+        # chaos the manager rotates servers, and each peer legitimately
+        # latched its own 2n/3 vote subset, so "which peer" varies)
+        for h in want:
+            live_rows = {
+                net.nodes[i].tx_store.load_cert_row(h) for i in (0, 1, 2)
+            }
+            assert node3.tx_store.load_cert_row(h) in live_rows
+            assert node3.tx_store.load_tx_bytes(h) == net.nodes[0].tx_store.load_tx_bytes(h)
+        snap = node3.sync_manager.snapshot()
+        assert snap["applied"] > 0  # recovery went through the sync path
+        # sync metrics visible in the node's own registry
+        expo = node3.metrics_registry.expose()
+        assert "txflow_sync_txs_applied" in expo
+    finally:
+        net.stop()
+
+
+def test_rejoin_commit_order_matches_server(tmp_path):
+    """Quiet (no chaos, no rotation) wipe-rejoin: the recovered node's
+    commit-order log must be byte-for-byte the serving peer's prefix —
+    sync applies in the server's per-node order, never a reshuffle."""
+    net = LocalNet(
+        4,
+        use_device_verifier=False,
+        enable_consensus=False,
+        sync_config=_fast_sync_cfg(),
+    )
+    net.make_durable(3, str(tmp_path / "node3"))
+    net.start()
+    try:
+        txs = [b"fee=1;order-%d=v" % i for i in range(25)]
+        _commit_set(net, txs)
+        net.crash_node(3)
+        net.wipe_node(3)
+        node3 = net.revive_node(3)
+        want = [hashlib.sha256(t).hexdigest().upper() for t in txs]
+        assert _wait_has_all(node3, want, 30)
+        server_id = node3.sync_manager.last_server
+        server = next(n for n in net.nodes if n.node_id == server_id)
+        n3 = node3.tx_store.seq_count()
+        mine = [h for _s, h in node3.tx_store.committed_range(0, n3)]
+        theirs = [h for _s, h in server.tx_store.committed_range(0, n3)]
+        assert mine == theirs
+    finally:
+        net.stop()
+
+
+# -- Byzantine sync servers --
+
+
+def _byzantine_drill(tmp_path, tamper, expect_ban=True):
+    """Shared rig: commit, wipe node 3, make node 0 a Byzantine sync
+    server via the tamper hook, revive node 3 — it must strike/ban node
+    0, rotate to an honest server, and still converge cleanly.
+
+    node 0 is deterministically the FIRST server tried: revive_node
+    reconnects peers in index order and _select_peer breaks the
+    equal-advert/equal-score tie on iteration order, so the tampered
+    response is always what the client sees first.
+    """
+    net = LocalNet(
+        4,
+        use_device_verifier=False,
+        enable_consensus=False,
+        sync_config=_fast_sync_cfg(),
+    )
+    net.make_durable(3, str(tmp_path / "node3"))
+    net.start()
+    try:
+        txs = [b"fee=1;byz-%d=v" % i for i in range(20)]
+        _commit_set(net, txs)
+        net.crash_node(3)
+        net.wipe_node(3)
+        net.nodes[0].sync_reactor.tamper = tamper
+        node3 = net.revive_node(3)
+        want = [hashlib.sha256(t).hexdigest().upper() for t in txs]
+        assert _wait_has_all(node3, want, 45), node3.sync_manager.snapshot()
+        snap = node3.sync_manager.snapshot()
+        # detected: the lie was a strike, not a silent accept; the liar
+        # is locally banned from re-selection and the client rotated to
+        # an honest server
+        assert snap["byzantine_strikes"] >= 1, snap
+        if expect_ban:
+            assert "node0" in snap["banned_peers"], snap
+        assert snap["rotations"] >= 1
+        # ... and the recovered state is NOT poisoned: rows match an
+        # honest server byte-for-byte
+        src = net.nodes[1]
+        for h in want:
+            assert node3.tx_store.load_cert_row(h) == src.tx_store.load_cert_row(h)
+        return snap
+    finally:
+        net.stop()
+
+
+def test_byzantine_forged_certificate(tmp_path):
+    def forge(entries, snapshots):
+        out = []
+        for h, cert, tx in entries:
+            # flip a byte inside the cert blob's middle (signature
+            # region): the cert still decodes, a signature no longer
+            # verifies
+            mid = len(cert) // 2
+            cert = cert[:mid] + bytes([cert[mid] ^ 0xFF]) + cert[mid + 1 :]
+            out.append((h, cert, tx))
+        return out, snapshots
+
+    _byzantine_drill(tmp_path, forge)
+
+
+def test_byzantine_wrong_epoch_snapshot(tmp_path):
+    evil_pv = MockPV(hashlib.sha256(b"evil-epoch").digest())
+    evil_set = ValidatorSet([Validator.from_pub_key(evil_pv.get_pub_key(), 99)])
+
+    def wrong_epoch(entries, snapshots):
+        # claim every served height's votes were cast under a different
+        # validator set — the client's OWN record must win, and the
+        # mismatch must read as a strike
+        return entries, {h: evil_set for h in snapshots} or {0: evil_set}
+
+    _byzantine_drill(tmp_path, wrong_epoch)
+
+
+def test_byzantine_truncated_range(tmp_path):
+    def truncate(entries, snapshots):
+        # serve fewer entries than the response's own advert admits
+        return entries[: max(1, len(entries) // 2)], snapshots
+
+    _byzantine_drill(tmp_path, truncate)
+
+
+def test_byzantine_tx_hash_mismatch(tmp_path):
+    def swap_tx(entries, snapshots):
+        # serve tx bytes that don't hash to the certified tx_hash
+        return [(h, cert, tx + b"!") for h, cert, tx in entries], snapshots
+
+    _byzantine_drill(tmp_path, swap_tx)
+
+
+# -- graceful degradation: no peer can serve --
+
+
+def test_fallback_when_no_peer_can_serve(tmp_path):
+    """Every candidate server serves empty ranges (a Byzantine strike),
+    so both get banned and no servable peer remains -> after max_rounds
+    failed rounds the client degrades to the consensus-block fallback
+    state instead of spinning, and surfaces it in /health's sync
+    section."""
+    net = LocalNet(
+        3,
+        use_device_verifier=False,
+        enable_consensus=False,
+        sync_config=_fast_sync_cfg(max_rounds=2, fallback_cooldown=30.0),
+    )
+    net.make_durable(2, str(tmp_path / "node2"))
+    net.start()
+    try:
+        txs = [b"fee=1;fb-%d=v" % i for i in range(10)]
+        _commit_set(net, txs)
+        net.crash_node(2)
+        net.wipe_node(2)
+
+        def serve_nothing(entries, snapshots):
+            return [], {}
+
+        for i in (0, 1):
+            net.nodes[i].sync_reactor.tamper = serve_nothing
+        node2 = net.revive_node(2)
+        deadline = time.monotonic() + 30
+        snap = {}
+        while time.monotonic() < deadline:
+            snap = node2.sync_manager.snapshot()
+            if snap["state"] == "fallback":
+                break
+            time.sleep(0.1)
+        assert snap["state"] == "fallback", snap
+        assert snap["fallbacks"] >= 1
+        # degraded, loudly: the health registry flips unhealthy
+        reg = node2.health.registry
+        reg.refresh(node2)
+        health = reg.snapshot()
+        assert health["sync"]["state"] == "fallback"
+        assert not health["healthy"]
+    finally:
+        net.stop()
+
+
+# -- stall / rotation / backoff --
+
+
+def test_stall_rotates_to_live_server(tmp_path):
+    """A server that never answers range requests is a stall (timeout),
+    not a Byzantine strike: milder penalty, rotation, and the client
+    still converges via the next peer."""
+    net = LocalNet(
+        3,
+        use_device_verifier=False,
+        enable_consensus=False,
+        sync_config=_fast_sync_cfg(request_timeout=0.4),
+    )
+    net.make_durable(2, str(tmp_path / "node2"))
+    net.start()
+    try:
+        txs = [b"fee=1;stall-%d=v" % i for i in range(10)]
+        _commit_set(net, txs)
+        net.crash_node(2)
+        net.wipe_node(2)
+
+        # node 0 adverts (status flows) but its range responses arrive
+        # far past request_timeout: to the client that is a stall, not a
+        # provable lie
+        def black_hole(entries, snapshots):
+            time.sleep(5)  # well past request_timeout
+            return entries, snapshots
+
+        net.nodes[0].sync_reactor.tamper = black_hole
+        node2 = net.revive_node(2)
+        want = [hashlib.sha256(t).hexdigest().upper() for t in txs]
+        assert _wait_has_all(node2, want, 45), node2.sync_manager.snapshot()
+        snap = node2.sync_manager.snapshot()
+        # if node 0 was ever selected first, a timeout + rotation was
+        # recorded; either way convergence happened via a live server
+        assert snap["applied"] >= len(want)
+        if snap["timeouts"]:
+            assert snap["rotations"] >= 1
+            assert "node0" not in snap["banned_peers"]  # stall != byzantine
+    finally:
+        net.stop()
+
+
+# -- lagging (not wiped) node: tail catch-up --
+
+
+def test_lagging_node_catches_up_without_wipe(tmp_path):
+    """A node partitioned away (links cut, no wipe) falls behind, then
+    rejoins: sync must close the gap from roughly its own count, and the
+    txs it already has must dedup (fetched counts only NEW work)."""
+    net = LocalNet(
+        4,
+        use_device_verifier=False,
+        enable_consensus=False,
+        sync_config=_fast_sync_cfg(),
+    )
+    net.make_durable(3, str(tmp_path / "node3"))
+    net.start()
+    try:
+        first = [b"fee=1;lag-a-%d=v" % i for i in range(12)]
+        _commit_set(net, first)
+        # crash (not wipe): committed state survives on disk
+        net.crash_node(3)
+        second = [b"fee=1;lag-b-%d=v" % i for i in range(12)]
+        for tx in second:
+            net.broadcast_tx(tx, node_index=0)
+        time.sleep(0.5)
+        node3 = net.revive_node(3)
+        assert node3.tx_store.seq_count() >= len(first)  # durable state intact
+        want = [hashlib.sha256(t).hexdigest().upper() for t in first + second]
+        assert _wait_has_all(node3, want, 45), node3.sync_manager.snapshot()
+    finally:
+        net.stop()
+
+
+# -- sync-only chaos scoping (satellite: FaultSpec.sync_only) --
+
+
+def test_fault_spec_sync_only_scoping():
+    from txflow_tpu.p2p.base import CHANNEL_SYNC, CHANNEL_MEMPOOL
+
+    spec = FaultSpec(seed=5, drop=0.5, delay=0.2)
+    sync_spec = spec.sync_only()
+    assert sync_spec.channels == SYNC_CHANNELS
+    assert CHANNEL_SYNC in sync_spec.channels
+    assert CHANNEL_MEMPOOL not in sync_spec.channels
+    # the default scope must NOT silently grow to include sync: that
+    # would shift every existing seeded chaos stream (one PRNG draw per
+    # in-scope message)
+    assert CHANNEL_SYNC not in GOSSIP_CHANNELS
+    assert spec.channels == GOSSIP_CHANNELS
+    # knobs carry over
+    assert sync_spec.drop == spec.drop and sync_spec.seed == spec.seed

@@ -20,7 +20,11 @@ faults active and one node black-holing its gossip mid-run. Asserts the
 admission SLOs: priority-lane p50 commit latency stays within 2x the
 unloaded baseline, every admitted priority tx commits (zero loss),
 evicted peers heal via the address-book re-dial, and shed traffic is
-visible in txflow_admission_* metrics. Also records a cross-node trace
+visible in txflow_admission_* metrics. Mid-flood, one durable node is
+SIGKILLed, its data dir DELETED, and restarted empty: it must recover
+the committed set from peers via catch-up sync (txflow_sync_* metrics,
+/health sync section settling back to idle/lag 0) with zero
+admitted-tx loss — the ISSUE-9 wipe-revive-rejoin drill. Also records a cross-node trace
 of the run (merged Chrome-trace JSON, SOAK_TRACE_OUT to choose the
 path) and asserts ZERO leaked/unclosed trace spans post-quiescence via
 each node's /health trace digest. Exits 1 with a SOAK STALL banner on
@@ -70,6 +74,7 @@ def overload_main(smoke: bool) -> None:
         os.environ.get("SOAK_COMMIT_WAIT", "30" if smoke else "120")
     )
     n = 4  # 3-of-4 quorum: commits keep flowing while node 0 black-holes
+    wipe_root = tempfile.mkdtemp(prefix="soak-wipe-")
     net = ProcNet(
         n,
         spec={
@@ -121,13 +126,21 @@ def overload_main(smoke: bool) -> None:
             # node 0 black-holes its OUTBOUND gossip mid-overload: its
             # peers see sends-without-progress, evict it by score, and
             # heal through the book re-dial (dials bypass chaos)
-            "per_node": {0: {"blackhole": {"start": 3.0, "duration": 2.5}}},
+            # node 3 runs durable stores so the wipe-revive-rejoin phase
+            # can SIGKILL it mid-flood, delete its data dir, and make it
+            # recover the committed set from peers via catch-up sync
+            "per_node": {
+                0: {"blackhole": {"start": 3.0, "duration": 2.5}},
+                3: {"data_dir": f"{wipe_root}/node3"},
+            },
         },
     )
     print(f"overload soak: starting {n}-process net ...", flush=True)
     net.start()
     try:
-        live = list(range(1, n))  # RPC targets; node 0 only gossips
+        # RPC targets for floods + probes: node 0 black-holes, node 3
+        # gets wiped mid-flood — neither may carry client traffic
+        live = [1, 2]
 
         def commit_latency(
             i: int, tx: str, timeout: float = 10.0
@@ -215,6 +228,17 @@ def overload_main(smoke: bool) -> None:
                 over_lat.append(lat)
             probe_i += 1
             time.sleep(0.25)
+        # wipe-revive-rejoin, still mid-flood: the probe window above
+        # measures steady-state overload (killing a validator mid-window
+        # would turn the quorum into exactly-3-of-4 under chaos and the
+        # SLO would measure quorum fragility, not admission), but the
+        # bulk flood keeps hammering while node 3 is SIGKILLed, loses its
+        # data dir, and rejoins empty — it must recover via catch-up sync
+        print("wipe drill: killing node 3 mid-flood", flush=True)
+        net.kill_node(3)
+        time.sleep(1.5)
+        print("wipe drill: restarting node 3 over a WIPED data dir", flush=True)
+        net.restart_node(3, wipe=True)
         stop_flood.set()
         for t in threads:
             t.join(timeout=15)
@@ -289,6 +313,48 @@ def overload_main(smoke: bool) -> None:
                 f"{len(remaining)}/{len(sample)} admitted bulk txs never "
                 f"committed (admitted-tx loss)"
             )
+
+        # -- wipe drill convergence: node 3 restarted over an EMPTY data
+        # dir and must have recovered the committed set from peers via
+        # catch-up sync — same sample, checked on the wiped node itself,
+        # plus the sync state machine settling back to idle/zero lag --
+        sync_deadline = time.monotonic() + commit_wait
+        wiped_remaining = set(sample) | set(slow_probes)
+        while wiped_remaining and time.monotonic() < sync_deadline:
+            wiped_remaining = {
+                h
+                for h in wiped_remaining
+                if not net.rpc_json(3, f"/tx?hash={h}")["result"]["committed"]
+            }
+            if wiped_remaining:
+                time.sleep(0.5)
+        if wiped_remaining:
+            stall(
+                f"wiped node 3 never recovered {len(wiped_remaining)} committed "
+                f"txs via sync (wipe-rejoin divergence)"
+            )
+        synced = net.metrics_value(3, "txflow_sync_txs_applied") or 0.0
+        if synced <= 0:
+            stall("wiped node 3 reports zero txflow_sync_txs_applied")
+        served = sum(
+            net.metrics_value(i, "txflow_sync_served_txs") or 0.0
+            for i in range(n - 1)
+        )
+        if served <= 0:
+            stall("no node served sync ranges during the wipe drill")
+        sync_state = {}
+        while time.monotonic() < sync_deadline:
+            sync_state = net.rpc_json(3, "/health")["result"].get("sync") or {}
+            if sync_state.get("state") == "idle" and sync_state.get("lag", 1) == 0:
+                break
+            time.sleep(0.5)
+        else:
+            stall(f"node 3 sync never settled to idle/lag 0: {sync_state}")
+        print(
+            f"wipe drill: node 3 recovered {synced:.0f} txs via sync "
+            f"({served:.0f} served by peers), settled idle",
+            flush=True,
+        )
 
         # -- trace: record the run + assert zero leaked spans. Every
         # begin()'d span (device tickets, commit-queue residency) must

@@ -37,6 +37,29 @@ def parse_fee(tx: bytes) -> int:
         return 0
 
 
+# sender tags ride the same self-describing prefix convention as fees
+# (``fee=<n>;from=<id>;<payload>`` or ``from=<id>;...``); bound the scan
+# so classification stays O(1) on hostile megabyte txs
+_SENDER_SCAN_LIMIT = 96
+
+
+def parse_sender(tx: bytes) -> str:
+    """Sender identity declared by a ``from=<id>;`` tag in the tx's
+    prefix region; "" when absent or malformed (untagged txs carry no
+    identity to be fair BETWEEN, so the per-sender budget skips them —
+    the lane-wide headroom still bounds the aggregate)."""
+    at = tx.find(b"from=", 0, _SENDER_SCAN_LIMIT)
+    if at < 0:
+        return ""
+    end = tx.find(b";", at + 5, at + 5 + _SENDER_SCAN_LIMIT)
+    if end < 0:
+        return ""
+    try:
+        return tx[at + 5 : end].decode("ascii")
+    except UnicodeDecodeError:
+        return ""
+
+
 class FeeLaneClassifier:
     """tx -> lane via the fee prefix (the default NodeConfig classifier)."""
 

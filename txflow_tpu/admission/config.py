@@ -83,3 +83,18 @@ class AdmissionConfig:
     # bounded number of tracked peer buckets (LRU-ish eviction of the
     # stalest bucket when full — unbounded peer churn can't grow memory)
     peer_max: int = 256
+
+    # -- per-sender fairness inside the priority lane (token bucket,
+    # tx/s; 0 disables) -- one fee-bearing flooder must not starve other
+    # priority senders. Sender identity is the tx's ``from=<id>;`` prefix
+    # tag (classifier.parse_sender); lane assignment itself is untouched
+    # (it must stay a deterministic function of the tx bytes), so an
+    # over-budget sender's txs are instead subjected to the BULK shed
+    # rules at the RPC edge (429 under pressure) while on-budget priority
+    # senders keep their unconditional admission
+    priority_sender_rate: float = 0.0
+    # per-sender burst depth (tx); 0 = one second's worth of the rate
+    priority_sender_burst: float = 0.0
+    # bounded number of tracked sender buckets (stalest-evicted like the
+    # peer buckets — hostile sender churn can't grow memory)
+    priority_sender_max: int = 256

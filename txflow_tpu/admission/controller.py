@@ -33,7 +33,7 @@ from ..trace.tracer import NULL_TRACER, SPAN_ADMISSION
 from ..utils.cache import make_lru
 from ..utils.clock import monotonic
 from ..utils.metrics import AdmissionMetrics
-from .classifier import FeeLaneClassifier
+from .classifier import FeeLaneClassifier, parse_sender
 from .config import AdmissionConfig
 
 
@@ -86,6 +86,13 @@ class AdmissionController:
         self._bulk_rate_eff = self.cfg.bulk_rate
         # per-peer gossip buckets: peer_id -> [tokens, last_refill_t]
         self._peer_buckets: dict[str, list] = {}
+        # priority-lane fairness buckets: sender -> [tokens, last_refill_t]
+        self._sender_buckets: dict[str, list] = {}
+        # durable-path degradation hook (wired by the node): True = the
+        # node can no longer persist (disk full / EIO) and must shed
+        # ingest like an overloaded node instead of accepting txs it
+        # cannot recover after a crash
+        self.degraded_source = None  # () -> bool
         # per-tx tracing (trace/tracer.py): the admission verdict is the
         # first span on a traced tx's timeline; wired by the node
         self.tracer = NULL_TRACER
@@ -194,10 +201,22 @@ class AdmissionController:
                 return False
             return True
 
+    def _storage_degraded(self) -> bool:
+        """Durable-path degradation verdict (node-wired; never errors)."""
+        src = self.degraded_source
+        if src is None:
+            return False
+        try:
+            return bool(src())
+        except Exception:
+            return False
+
     def _bulk_shed(self, now: float | None = None) -> bool:
-        """Should a bulk-lane tx be shed right now? Overload, the bulk
-        lane alone crowding past its headroom fraction of the pool, or
-        the bulk admit-rate bucket running dry."""
+        """Should a bulk-lane tx be shed right now? Storage degradation,
+        overload, the bulk lane alone crowding past its headroom fraction
+        of the pool, or the bulk admit-rate bucket running dry."""
+        if self._storage_degraded():
+            return True
         if self.overloaded(now):
             return True
         bulk = self.mempool.lane_size(LANE_BULK)
@@ -222,7 +241,18 @@ class AdmissionController:
             self.metrics.rejected_dup.add(1)
             raise ErrDuplicateTx(f"tx {key.hex()[:16]} replayed at the edge")
         lane = self.lane_of(tx)
-        if lane != LANE_PRIORITY and self._bulk_shed(now):
+        if lane == LANE_PRIORITY:
+            # per-sender fairness: an over-budget priority sender keeps
+            # its LANE (lane assignment stays a pure function of the tx
+            # bytes) but loses the lane's unconditional admission — its
+            # overflow is subjected to the same shed rules as bulk
+            sender = parse_sender(tx)
+            if sender and self._priority_sender_exceeded(sender, now):
+                self.metrics.priority_sender_limited.add(1)
+                if self._bulk_shed(now):
+                    self.metrics.priority_sender_shed.add(1)
+                    raise ErrOverloaded(self.cfg.retry_after)
+        elif self._bulk_shed(now):
             self.metrics.rejected_overload.add(1)
             raise ErrOverloaded(self.cfg.retry_after)
         with self._mtx:
@@ -240,6 +270,39 @@ class AdmissionController:
         for a non-dup reason) so the client's retry isn't dup-bounced."""
         with self._mtx:
             self.dedup.remove(key)
+
+    def _priority_sender_exceeded(
+        self, sender: str, now: float | None = None
+    ) -> bool:
+        """Per-sender token-bucket verdict for ONE priority admission
+        (consumes a token on pass). Disabled when the rate knob is 0.
+        Same bounded-dict discipline as the peer buckets: at
+        priority_sender_max the stalest bucket is evicted."""
+        rate = self.cfg.priority_sender_rate
+        if rate <= 0:
+            return False
+        if now is None:
+            now = monotonic()
+        cap = max(self.cfg.priority_sender_burst, rate, 1.0)
+        with self._mtx:
+            b = self._sender_buckets.get(sender)
+            if b is None:
+                if len(self._sender_buckets) >= max(1, self.cfg.priority_sender_max):
+                    stalest = min(
+                        self._sender_buckets, key=lambda k: self._sender_buckets[k][1]
+                    )
+                    del self._sender_buckets[stalest]
+                b = self._sender_buckets[sender] = [cap, now]
+                self.metrics.priority_sender_tracked.set(len(self._sender_buckets))
+            tokens, last = b
+            if now > last:
+                tokens = min(cap, tokens + (now - last) * rate)
+            b[1] = now
+            if tokens >= 1.0:
+                b[0] = tokens - 1.0
+                return False
+            b[0] = tokens
+            return True
 
     # -- gossip edge --
 

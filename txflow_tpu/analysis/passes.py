@@ -441,6 +441,7 @@ _HOT_NOBLOCK_FUNCS = {
         "admit_rpc", "admit_gossip", "lane_of", "overloaded",
         "_bulk_shed", "_bulk_rate_exceeded", "forget", "gossip_paused",
         "_sample_commit_rate", "_effective_bulk_rate", "_peer_rate_exceeded",
+        "_priority_sender_exceeded", "_storage_degraded",
     },
 }
 
@@ -502,6 +503,7 @@ _TRACE_SCOPE = (
     "txflow_tpu/admission/controller.py",
     "txflow_tpu/pool/",
     "txflow_tpu/reactors/",
+    "txflow_tpu/sync/",
 )
 
 # the forbidden time.* names: every raw timestamp source. time.sleep is

@@ -51,6 +51,7 @@ from ..analysis.lockgraph import make_rlock
 from ..utils.cache import make_lru
 from ..utils.clock import monotonic
 from ..utils.config import EngineConfig
+from ..utils.failpoints import FailpointError
 from ..utils.metrics import TxFlowMetrics
 from ..verifier import DeviceVoteVerifier, ReadyTicket, ScalarVoteVerifier
 from .execution import TxExecutor
@@ -292,6 +293,15 @@ class TxFlow:
         self._pipe_active_s = 0.0
         self._pipe_last_collect = 0.0
         self._pipe_lock_wait_s = 0.0
+        # durable-path degradation (ENOSPC/EIO/failpoint on TxStore
+        # writes): the commit stays applied in memory and the node keeps
+        # serving, but it flags itself degraded — surfaced via /health
+        # ("storage" section) and the admission front door, which sheds
+        # while degraded. Crashing would lose the in-memory committed
+        # state; silence would hide that durability is gone.
+        self.storage_degraded = False
+        self.storage_errors = 0
+        self.storage_last_error = ""
         # per-tx tracing (trace/tracer.py): wired by the node before
         # start(); NULL_TRACER keeps every hook a no-op attribute check
         self.tracer = NULL_TRACER
@@ -1141,7 +1151,11 @@ class TxFlow:
         by now the block path (claim_vtx) may own the delivery, or the
         bytes may have arrived: resolve ownership under _mtx exactly like
         _commit_batch does, and never apply twice."""
-        self.tx_store.save_tx(vs, votes=quorum_votes)
+        had_tx = tx is not None
+        try:
+            self.tx_store.save_tx(vs, votes=quorum_votes, tx=tx)
+        except (OSError, FailpointError) as e:
+            self._note_storage_error(e)
         if tx is None:
             with self._mtx:
                 if deferred and vs.tx_hash not in self._unapplied:
@@ -1154,6 +1168,8 @@ class TxFlow:
                         self._unapplied[vs.tx_hash] = vs.tx_key
                     elif deferred:
                         del self._unapplied[vs.tx_hash]
+        if tx is not None and not had_tx:
+            self._save_tx_bytes_late(vs.tx_hash, tx)
         if tx is not None:
             # the hash handed to events/indexer must describe the tx actually
             # fetched and applied: tx came from mempool.get_tx(vs.tx_key), and
@@ -1233,8 +1249,13 @@ class TxFlow:
         reference-faithful per-tx apply_tx path, txflow/service.go:216-
         232; >1 amortizes the fence via apply_tx_batch)."""
         # one store write group for the whole wake (one lock / append /
-        # fsync instead of ~6 locked db ops per commit — r4 judge profile)
-        self.tx_store.save_txs_batch([(vs, votes) for vs, votes, _ in items])
+        # fsync instead of ~6 locked db ops per commit — r4 judge profile);
+        # items are (vs, votes, tx): the decision-time bytes ride along so
+        # catch-up servers can hand them to wiped peers (T: rows)
+        try:
+            self.tx_store.save_txs_batch(items)
+        except (OSError, FailpointError) as e:
+            self._note_storage_error(e)
         apply_items: list[tuple] = []
         deferred = 0
         retired = 0  # applied by claim_vtx/_apply_unapplied before this wake
@@ -1258,6 +1279,7 @@ class TxFlow:
                         deferred += 1
                         continue  # still waiting for bytes
                     del self._unapplied[vs.tx_hash]
+                self._save_tx_bytes_late(vs.tx_hash, tx)
             apply_items.append((vs, tx))
         if not apply_items:
             with self._mtx:
@@ -1287,6 +1309,78 @@ class TxFlow:
             self._trace_commit_end(vs.tx_hash)
         with self._mtx:  # see the early-return comment above
             self._applied_count += len(items) - deferred - retired
+
+    def _note_storage_error(self, exc: BaseException) -> None:
+        """A durable-path write failed (ENOSPC/EIO or an armed failpoint):
+        degrade loudly instead of crashing. The commit stays applied in
+        memory; health surfaces the flag ("storage" section) and the
+        admission front door sheds while it is set."""
+        self.storage_degraded = True
+        self.storage_errors += 1
+        self.storage_last_error = repr(exc)
+        m = getattr(self.metrics, "storage_errors", None)
+        if m is not None:
+            m.add(1)
+
+    def _save_tx_bytes_late(self, tx_hash: str, tx: bytes) -> None:
+        """T: row for a certificate whose bytes arrived after the save
+        (deferred apply) — never under _mtx, and never fatal."""
+        try:
+            self.tx_store.save_tx_bytes(tx_hash, tx)
+        except (OSError, FailpointError) as e:
+            self._note_storage_error(e)
+
+    def apply_synced_commit(
+        self, vs: TxVoteSet, votes: list[TxVote], tx: bytes
+    ) -> bool:
+        """Commit a certificate fetched (and already verified) by the
+        catch-up client (sync/manager.py), sharing the live commit seam:
+        the _committed mark is pushed under _mtx exactly like a fast-path
+        decision, so a racing local quorum or claim_vtx sees it and never
+        double-applies; the TxStore save assigns the next local seq, so
+        the per-node commit-order log extends in the server's order;
+        store-then-apply ordering matches _commit_effects.
+
+        The caller MUST have verified the certificate (2n/3 stake at the
+        vote height's validator set) and that sha256(tx) matches
+        vs.tx_hash — sign bytes zero TxKey (types.tx_vote), so the vote's
+        own tx_key field is forgeable and is never trusted here.
+
+        Returns False when the tx was already committed locally (dedup:
+        overlap between a sync range and live gossip is normal)."""
+        import hashlib
+
+        tx_key = hashlib.sha256(tx).digest()
+        tx_hash = tx_key.hex().upper()
+        with self._mtx:
+            if self._committed.__contains__(_hash_key(tx_hash)) or (
+                self.tx_store.has_tx(tx_hash)
+            ):
+                return False
+            live = self.vote_sets.pop(tx_hash, None)
+            self._committed.push(_hash_key(tx_hash))
+            self._decided_count += 1
+        if live is not None:
+            # a below-quorum local aggregation was racing the sync apply:
+            # release its pool votes (same leak claim_vtx plugs)
+            self.tx_vote_pool.update(self.height, live.votes_snapshot())
+        try:
+            self.tx_store.save_tx(vs, votes=votes, tx=tx)
+        except (OSError, FailpointError) as e:
+            self._note_storage_error(e)
+        app_hash, _ = self.tx_executor.apply_tx(
+            self.height, tx, tx_hash, tx_key=tx_key
+        )
+        self.app_hash = app_hash
+        self.metrics.committed_txs.add(1)
+        self.metrics.committed_votes.add(len(votes))
+        try:
+            self.commitpool.check_tx(tx, key=tx_key)
+        except Exception:
+            pass  # commitpool dup (e.g. replays) is harmless
+        with self._mtx:
+            self._applied_count += 1
+        return True
 
     def commits_drained(self) -> bool:
         """True when every decided commit has been applied (the pipelined
@@ -1336,6 +1430,7 @@ class TxFlow:
                 if tx_hash not in self._unapplied:
                     continue
                 del self._unapplied[tx_hash]
+            self._save_tx_bytes_late(tx_hash, tx)
             app_hash, _ = self.tx_executor.apply_tx(
                 self.height, tx, tx_key.hex().upper(), tx_key=tx_key
             )

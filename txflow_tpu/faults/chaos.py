@@ -8,9 +8,10 @@ through one scheduler thread and re-enter the peer's queue via
 not re-faulted — one decision per offered message).
 
 Partitions are orthogonal to the probabilistic plan: ``partition()``
-black-holes in-scope traffic crossing group boundaries without consuming
-the per-link PRNG streams, so ``heal()`` resumes the seeded sequence
-exactly where it left off.
+black-holes ALL traffic crossing group boundaries (every channel, even
+ones outside the plan's scope — a partition is a physical cut) without
+consuming the per-link PRNG streams, so ``heal()`` resumes the seeded
+sequence exactly where it left off.
 """
 
 from __future__ import annotations
@@ -66,7 +67,7 @@ class ChaosRouter:
     # -- partitions --
 
     def partition(self, *groups) -> None:
-        """Cut in-scope traffic between the given node-id groups (and
+        """Cut all traffic between the given node-id groups (and
         between any listed group and unlisted nodes)."""
         self._partition = tuple(frozenset(g) for g in groups)
 
@@ -90,11 +91,14 @@ class ChaosRouter:
 
     def _route(self, peer, src: str, dst: str, chan_id: int, msg: bytes):
         # partition first, without consuming link randomness: heal()
-        # resumes the seeded fault sequence where it paused
-        if self._partition is not None and self.plan.in_scope(chan_id):
-            if self._crosses_partition(src, dst):
-                self.stats["partitioned"] += 1
-                return True  # swallowed: sender sees success (black hole)
+        # resumes the seeded fault sequence where it paused. Partitions
+        # cut EVERY channel regardless of plan scope — they model a
+        # physical link cut, and a scoped side channel (e.g. catch-up
+        # sync STATUS heartbeats) crossing the cut would feed the peer
+        # scorer false liveness during partition drills
+        if self._partition is not None and self._crosses_partition(src, dst):
+            self.stats["partitioned"] += 1
+            return True  # swallowed: sender sees success (black hole)
         kind, delay = self.plan.decide(src, dst, chan_id)
         if kind == DELIVER:
             return None  # pass through untouched

@@ -20,13 +20,18 @@ import random
 import threading
 from dataclasses import dataclass
 
-from ..p2p.base import CHANNEL_MEMPOOL, CHANNEL_TXVOTE
+from ..p2p.base import CHANNEL_MEMPOOL, CHANNEL_SYNC, CHANNEL_TXVOTE
 
 # default chaos scope: the at-least-once gossip channels. Consensus
 # channels (0x20-0x22) are push-once state-machine traffic; faulting them
 # exercises the BFT view-change path, not the fast path, and needs its
 # own liveness budget — opt in via FaultSpec.channels.
+# The catch-up channel (0x3A) is deliberately NOT in the default scope:
+# adding it would consume extra PRNG draws per link and shift the
+# deterministic fault trace of every existing seeded test. Opt in with
+# ``channels=GOSSIP_CHANNELS | SYNC_CHANNELS`` or FaultSpec.sync_only().
 GOSSIP_CHANNELS = frozenset((CHANNEL_MEMPOOL, CHANNEL_TXVOTE))
+SYNC_CHANNELS = frozenset((CHANNEL_SYNC,))
 
 # decision kinds (first element of a trace entry / decide() result)
 DELIVER = "deliver"
@@ -70,6 +75,22 @@ class FaultSpec:
             raise ValueError("need 0 <= delay_min <= delay_max")
         if self.liveness_budget <= 0:
             raise ValueError("liveness_budget must be positive")
+
+    def sync_only(self) -> "FaultSpec":
+        """This spec rescoped to the catch-up channel alone (0x3A):
+        drills that want a healthy fast path but a hostile recovery
+        plane — sync requests time out, rotate, back off — without
+        touching the gossip-channel fault traces."""
+        return FaultSpec(
+            seed=self.seed,
+            drop=self.drop,
+            duplicate=self.duplicate,
+            delay=self.delay,
+            delay_min=self.delay_min,
+            delay_max=self.delay_max,
+            channels=SYNC_CHANNELS,
+            liveness_budget=self.liveness_budget,
+        )
 
 
 class FaultPlan:

@@ -134,6 +134,24 @@ class PeerScoreBoard:
                 del self._tracks[nid]
         self._drain_reconnects(now)
 
+    # -- external penalties (sync Byzantine scoring) --
+
+    def punish(self, nid: str, amount: float, now: float | None = None) -> None:
+        """Apply an out-of-band score penalty (e.g. the sync client caught
+        this peer serving a forged certificate). Crossing the floor evicts
+        immediately instead of waiting for the next tick, so a Byzantine
+        sync server can't keep serving poison for another tick interval."""
+        if now is None:
+            now = time.monotonic()
+        tr = self._tracks.get(nid)
+        if tr is None:
+            tr = self._tracks[nid] = _PeerTrack(now)
+        tr.score -= amount
+        if tr.score <= self.cfg.score_floor and self.reconnector is not None:
+            peer = self.switch.get_peer(nid)
+            if peer is not None:
+                self._evict(peer, now)
+
     # -- eviction + reconnect --
 
     def _evict(self, peer, now: float) -> None:

@@ -36,6 +36,8 @@ class DegradedModeRegistry:
         self._verifier: dict = {}
         self._peers: dict = {}
         self._epoch: dict = {}
+        self._sync: dict = {}
+        self._storage: dict = {}
         self._watchdog_state: dict = {"inflight": 0, "oldest_stall_age": 0.0}
         self._healthy = True
 
@@ -149,17 +151,43 @@ class DegradedModeRegistry:
         rot = getattr(node.txflow, "last_rotation", None)
         if rot is not None:
             epoch_state["last_engine_rotation"] = dict(rot)
+        # catch-up sync (sync/manager.py): lag + state machine snapshot.
+        # "syncing" is self-healing and stays healthy; "fallback" means
+        # no peer can serve this node — degraded until the consensus
+        # block path (or a recovered peer) closes the gap
+        sm = getattr(node, "sync_manager", None)
+        sync_state = sm.snapshot() if sm is not None else {}
+        # durable-path degradation (engine save / pool WALs): a node that
+        # cannot persist commits is loudly degraded, never silently lossy
+        storage_state = {
+            "degraded": bool(getattr(node.txflow, "storage_degraded", False)),
+            "errors": getattr(node.txflow, "storage_errors", 0),
+            "last_error": getattr(node.txflow, "storage_last_error", ""),
+            "mempool_wal_degraded": bool(getattr(node.mempool, "wal_degraded", False)),
+            "txvote_wal_degraded": bool(
+                getattr(node.tx_vote_pool, "wal_degraded", False)
+            ),
+        }
+        storage_degraded = (
+            storage_state["degraded"]
+            or storage_state["mempool_wal_degraded"]
+            or storage_state["txvote_wal_degraded"]
+        )
         stalled = self._watchdog_state["oldest_stall_age"]
         healthy = (
             (not vstate or vstate["device_healthy"])
             and stalled < 2 * max(self._stall_timeout_hint, 0.001)
             and not (n_peers == 0 and progress["txvotepool_size"] > 0)
+            and sync_state.get("state") != "fallback"
+            and not storage_degraded
         )
         with self._mtx:
             self._progress = progress
             self._verifier = vstate
             self._peers = {"n_peers": n_peers}
             self._epoch = epoch_state
+            self._sync = sync_state
+            self._storage = storage_state
             self._healthy = healthy
         self.metrics.healthy.set(1.0 if healthy else 0.0)
 
@@ -193,4 +221,6 @@ class DegradedModeRegistry:
                 "verifier": dict(self._verifier),
                 "progress": dict(self._progress),
                 "epoch": dict(self._epoch),
+                "sync": dict(self._sync),
+                "storage": dict(self._storage),
             }

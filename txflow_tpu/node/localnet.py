@@ -51,6 +51,8 @@ class LocalNet:
         health_config=None,  # HealthConfig override (health/config.py)
         voting_powers: list[int] | None = None,  # per-validator stake override
         epoch_config=None,  # EpochConfig: rotation/slashing (epoch/)
+        sync: bool = True,  # catch-up sync channel + client (sync/)
+        sync_config=None,  # SyncConfig override (sync/config.py)
     ):
         """n_nodes: host only the first n_nodes validators as full nodes
         (default: one node per validator). A large validator set does not
@@ -130,6 +132,8 @@ class LocalNet:
         self._health = health
         self._health_config = health_config
         self._epoch_config = epoch_config
+        self._sync = sync
+        self._sync_config = sync_config
         self._durable_roots: dict[int, str] = {}
         self._down: set[int] = set()
         hosted = priv_vals if n_nodes is None else priv_vals[:n_nodes]
@@ -185,6 +189,8 @@ class LocalNet:
                 health=self._health,
                 health_config=self._health_config,
                 epoch_config=self._epoch_config,
+                sync=self._sync,
+                sync_config=self._sync_config,
             ),
             **dbs,
         )
@@ -252,6 +258,22 @@ class LocalNet:
         node.stop()
         self._down.add(i)
         return node
+
+    def wipe_node(self, i: int) -> None:
+        """Delete node i's durable artifacts while it is down — the
+        wipe-and-rejoin drill. revive_node then rebuilds it over EMPTY
+        stores (a freshly-joined node for all practical purposes) and it
+        must recover the committed set from peers via catch-up sync."""
+        if i not in self._down:
+            raise RuntimeError(f"node {i} must be crashed before wiping")
+        root = self._durable_roots.get(i)
+        if root is None:
+            raise RuntimeError(f"node {i} has no durable root to wipe")
+        import os
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+        os.makedirs(root, exist_ok=True)
 
     def revive_node(self, i: int) -> Node:
         """Rebuild node i over its durable artifacts (fresh app instance,

@@ -106,6 +106,13 @@ class NodeConfig:
     # driven slashing at deterministic epoch boundaries. None or
     # length=0 = static set (seed behavior); see epoch/config.py
     epoch_config: object = None
+    # catch-up sync (sync/): every node serves committed ranges on the
+    # sync channel; the client half (lag detection + fetch/verify/apply)
+    # runs only when this is on. False = serve-only is also off (seed
+    # behavior — recovery is the consensus-block path alone)
+    sync: bool = True
+    # SyncConfig override (None = defaults; see sync/config.py)
+    sync_config: object = None
 
 
 class Node:
@@ -418,6 +425,46 @@ class Node:
                     book_reconnector(self.switch, self.address_book)
                 )
 
+        # -- catch-up sync (sync/): server half on every sync-enabled
+        # node (read-only range serving), client half on its own thread.
+        # Assembled after health so Byzantine strikes reach the same
+        # scoreboard that drives eviction + reconnect backoff --
+        self.sync_reactor = None
+        self.sync_manager = None
+        if nc.sync:
+            from ..sync import SyncManager, SyncReactor
+            from ..utils.metrics import SyncMetrics
+
+            self.sync_reactor = SyncReactor(
+                self.tx_store,
+                state_store=self.state_store,
+                current_vals=lambda: self.state_view().validators,
+                config=nc.sync_config,
+            )
+            self.sync_manager = SyncManager(
+                chain_id,
+                self.tx_store,
+                self.txflow,
+                self.switch,
+                state_store=self.state_store,
+                config=nc.sync_config,
+                scoreboard=self.health.scoreboard if self.health else None,
+                metrics=SyncMetrics(self.metrics_registry),
+                tracer=self.tracer,
+            )
+            self.sync_reactor.manager = self.sync_manager
+            self.switch.add_reactor("sync", self.sync_reactor)
+
+        # -- durable-path degradation -> admission coupling: a node that
+        # can no longer persist (disk full / EIO) sheds ingest load like
+        # an overloaded one instead of accepting txs it cannot recover --
+        if self.admission is not None:
+            self.admission.degraded_source = lambda: (
+                self.txflow.storage_degraded
+                or self.mempool.wal_degraded
+                or self.tx_vote_pool.wal_degraded
+            )
+
         self._started = False
 
     # -- state view read by reactors (reference reads state.State) --
@@ -509,11 +556,15 @@ class Node:
             self.grpc.start()
         if self.health is not None:
             self.health.start()
+        if self.sync_manager is not None:
+            self.sync_manager.start()
 
     def stop(self) -> None:
         if not self._started:
             return
         self._started = False
+        if self.sync_manager is not None:
+            self.sync_manager.stop()
         if self.health is not None:
             self.health.stop()
         if self.rpc is not None:

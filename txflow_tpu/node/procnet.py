@@ -30,6 +30,7 @@ class ProcNet:
         self.spec = dict(spec or {})
         self.children: list[subprocess.Popen] = []
         self.infos: list[dict] = []
+        self._specs: list[dict] = []  # resolved per-child spec (restarts)
 
     # -- lifecycle --
 
@@ -48,6 +49,7 @@ class ProcNet:
             self.children.append(child)
             spec = dict(self.spec, index=i, n=self.n)
             spec.update(per_node.get(i) or per_node.get(str(i)) or {})
+            self._specs.append(spec)
             child.stdin.write(json.dumps(spec) + "\n")
             child.stdin.flush()
         deadline = time.monotonic() + timeout
@@ -83,6 +85,64 @@ class ProcNet:
                 )
             time.sleep(0.1)
 
+    # -- crash / wipe / rejoin (the soak's wipe-revive-rejoin phase) --
+
+    def kill_node(self, i: int) -> None:
+        """SIGKILL child i mid-run (no graceful stop: a crash). Peers see
+        the TCP links die; the child's durable state is whatever its
+        stores fsynced."""
+        child = self.children[i]
+        child.kill()
+        child.wait(timeout=10)
+
+    def restart_node(self, i: int, wipe: bool = False, timeout: float = 60.0) -> None:
+        """Respawn child i with its original spec (same deterministic
+        validator identity/node key). ``wipe=True`` first deletes its
+        data_dir — the rebuilt node starts empty and must recover the
+        committed set from peers via catch-up sync. The new child gets
+        the current peer map; the mesh reforms through its outbound PEX
+        dials (peers' stale book entries don't matter — inbound links
+        count)."""
+        spec = dict(self._specs[i])
+        if wipe:
+            data_dir = spec.get("data_dir")
+            if not data_dir:
+                raise RuntimeError(f"procnode {i} has no data_dir to wipe")
+            import shutil
+
+            shutil.rmtree(data_dir, ignore_errors=True)
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO_ROOT)
+        child = subprocess.Popen(
+            [sys.executable, "-m", "txflow_tpu.node.procnode"],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        self.children[i] = child
+        child.stdin.write(json.dumps(spec) + "\n")
+        child.stdin.flush()
+        deadline = time.monotonic() + timeout
+        line = child.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"procnode {i} died during restart:\n{self._stderr_tail(i)}"
+            )
+        self.infos[i] = json.loads(line)
+        peers = {info["node_id"]: info["p2p"] for info in self.infos}
+        child.stdin.write(json.dumps({"peers": peers}) + "\n")
+        child.stdin.flush()
+        while True:
+            try:
+                if self.rpc_json(i, "/net_info")["result"]["n_peers"] >= 1:
+                    return
+            except (OSError, ValueError, KeyError):
+                pass
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"restarted procnode {i} never rejoined the mesh")
+            time.sleep(0.1)
+
     def stop(self, timeout: float = 15.0) -> None:
         for child in self.children:
             try:
@@ -97,6 +157,7 @@ class ProcNet:
                 child.kill()
         self.children = []
         self.infos = []
+        self._specs = []
 
     def _stderr_tail(self, i: int, n: int = 4000) -> str:
         try:

@@ -19,6 +19,8 @@ Spec line fields (all optional except index/n/seed_prefix):
      "health": {"score_floor": -4.0},      # HealthConfig kwargs
      "fault": {"drop": 0.02, "seed": 7},   # FaultSpec kwargs (chaos on)
      "regossip": 0.25,
+     "data_dir": "/tmp/soak/node0",        # durable stores + WALs (wipe drills)
+     "sync": {"lag_threshold": 1},         # SyncConfig kwargs (or false = off)
      "blackhole": {"start": 3.0, "duration": 2.0}}
 
 ``blackhole`` makes THIS child's chaos router partition itself away for
@@ -70,6 +72,24 @@ def main() -> None:
     for k, v in (spec.get("trace") or {}).items():
         setattr(config.trace, k, v)
 
+    # durable stores under data_dir (wipe-revive drills: the parent can
+    # kill this child, delete the dir, and restart it — the rebuilt node
+    # must recover the committed set from peers via catch-up sync)
+    dbs = {}
+    data_dir = spec.get("data_dir")
+    if data_dir:
+        import os
+
+        from ..store.db import FileDB
+
+        os.makedirs(data_dir, exist_ok=True)
+        dbs = {
+            "tx_store_db": FileDB(f"{data_dir}/txstore.db"),
+            "state_db": FileDB(f"{data_dir}/state.db"),
+            "block_db": FileDB(f"{data_dir}/blocks.db"),
+        }
+        config.mempool.wal_dir = data_dir
+
     admission_config = None
     if spec.get("admission"):
         from ..admission import AdmissionConfig
@@ -80,6 +100,13 @@ def main() -> None:
         from ..health.config import HealthConfig
 
         health_config = HealthConfig(**spec["health"])
+    sync_on = spec.get("sync", True)
+    sync_config = None
+    if isinstance(sync_on, dict):
+        from ..sync import SyncConfig
+
+        sync_config = SyncConfig(**sync_on)
+        sync_on = True
 
     node = Node(
         node_id=f"proc-{index}",
@@ -96,7 +123,10 @@ def main() -> None:
             regossip_interval=spec.get("regossip", 0.25),
             admission_config=admission_config,
             health_config=health_config,
+            sync=bool(sync_on),
+            sync_config=sync_config,
         ),
+        **dbs,
     )
 
     router = None

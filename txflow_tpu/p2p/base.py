@@ -14,6 +14,9 @@ CHANNEL_CONSENSUS_DATA = 0x21
 CHANNEL_CONSENSUS_VOTE = 0x22
 CHANNEL_MEMPOOL = 0x30
 CHANNEL_TXVOTE = 0x32
+# catch-up sync (sync/reactor.py). 0x38 is already the evidence channel,
+# so the sync channel takes the next free slot in the 0x3x range.
+CHANNEL_SYNC = 0x3A
 
 
 @dataclass(frozen=True)

@@ -21,6 +21,7 @@ from ..trace.tracer import NULL_TRACER, SPAN_TX_INGEST
 from ..utils.cache import make_lru
 from ..utils.clock import monotonic
 from ..utils.config import MempoolConfig
+from ..utils.failpoints import FailpointError
 from ..utils.wal import WAL
 from .base import COMPACT_THRESHOLD, IngestLogPool
 
@@ -110,6 +111,12 @@ class Mempool(IngestLogPool):
         self._notified_txs_available = False
         self._notify_available = False
         self.wal: WAL | None = WAL(wal_path) if wal_path else None
+        # disk-full/EIO degradation: a failed WAL append flags the pool
+        # degraded (health "storage" section; admission sheds) and stops
+        # further appends — ingest itself keeps working, crash-replay
+        # durability is what was lost, and that must be LOUD, not fatal
+        self.wal_degraded = False
+        self.wal_errors = 0
 
     # -- introspection --
 
@@ -273,8 +280,12 @@ class Mempool(IngestLogPool):
             if err is not None:
                 self.cache.remove(key)
                 raise ValueError(f"rejected by post_check: {err}")
-        if self.wal is not None and write_wal:
-            self.wal.write(tx)  # txlint: allow(lock-blocking) -- WAL append order must match insertion order; buffered write, fsync only if sync_on_write
+        if self.wal is not None and write_wal and not self.wal_degraded:
+            try:
+                self.wal.write(tx)  # txlint: allow(lock-blocking) -- WAL append order must match insertion order; buffered write, fsync only if sync_on_write
+            except (OSError, FailpointError):
+                self.wal_degraded = True
+                self.wal_errors += 1
         gas = res.gas_wanted if res is not None else 0
         fast_path = getattr(res, "fast_path", True) if res is not None else True
         lane = LANE_BULK

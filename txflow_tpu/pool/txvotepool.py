@@ -29,6 +29,7 @@ from ..types import TxVote, decode_tx_vote, encode_tx_vote
 from ..utils.cache import make_lru
 from ..utils.clock import monotonic
 from ..utils.config import MempoolConfig
+from ..utils.failpoints import FailpointError
 from ..utils.wal import WAL
 from .base import COMPACT_THRESHOLD, IngestLogPool
 from .mempool import (
@@ -95,6 +96,9 @@ class TxVotePool(IngestLogPool):
         self._notified_txs_available = False
         self._notify_available = False
         self.wal: WAL | None = None
+        # see Mempool.wal_degraded: failed appends degrade loudly, once
+        self.wal_degraded = False
+        self.wal_errors = 0
         if wal_path:
             self.init_wal(wal_path)
 
@@ -253,7 +257,7 @@ class TxVotePool(IngestLogPool):
         log_append = self._log_append_quiet  # one _log_notify per group
         lane_of = self.lane_of_vote
         prio_append = self._prio_log.append
-        wal = self.wal if write_wal else None
+        wal = self.wal if write_wal and not self.wal_degraded else None
         oset = object.__setattr__
         new = _PoolVote.__new__
         # bounded lock holds: a whole gossip frame under one lock starved
@@ -302,7 +306,12 @@ class TxVotePool(IngestLogPool):
                         out[i] = ErrTxInCache()
                         continue
                     if wal is not None:
-                        wal.write(encoded)  # txlint: allow(lock-blocking) -- WAL append order must match ingest-log order; buffered write, fsync only if sync_on_write
+                        try:
+                            wal.write(encoded)  # txlint: allow(lock-blocking) -- WAL append order must match ingest-log order; buffered write, fsync only if sync_on_write
+                        except (OSError, FailpointError):
+                            self.wal_degraded = True
+                            self.wal_errors += 1
+                            wal = None
                     seg = vote._seg_cache
                     if seg is None:
                         seg = amino.length_prefixed(encoded)
@@ -368,8 +377,12 @@ class TxVotePool(IngestLogPool):
             if entry is not None:
                 entry.senders.add(tx_info.sender_id)
             raise ErrTxInCache()
-        if self.wal is not None and write_wal:
-            self.wal.write(encoded)  # txlint: allow(lock-blocking) -- WAL append order must match ingest-log order; buffered write, fsync only if sync_on_write
+        if self.wal is not None and write_wal and not self.wal_degraded:
+            try:
+                self.wal.write(encoded)  # txlint: allow(lock-blocking) -- WAL append order must match ingest-log order; buffered write, fsync only if sync_on_write
+            except (OSError, FailpointError):
+                self.wal_degraded = True
+                self.wal_errors += 1
         seg = vote._seg_cache
         if seg is None:
             seg = amino.length_prefixed(encoded)

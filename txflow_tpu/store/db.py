@@ -14,6 +14,8 @@ import threading
 import zlib
 from typing import Iterator
 
+from ..utils.failpoints import fail
+
 
 class DB:
     def get(self, key: bytes) -> bytes | None:
@@ -120,6 +122,7 @@ class FileDB(DB):
                 f.truncate(good_end)
 
     def _append(self, key: bytes, value: bytes | None, sync: bool) -> None:
+        fail("filedb.append")  # ENOSPC/EIO drills (tests/test_diskfull.py)
         body = key + (value or b"")
         rec = _REC.pack(zlib.crc32(body), len(key), -1 if value is None else len(value)) + body
         self._f.write(rec)
@@ -147,6 +150,7 @@ class FileDB(DB):
         if not pairs:
             return
         with self._mtx:
+            fail("filedb.append")  # ENOSPC/EIO drills (tests/test_diskfull.py)
             buf = bytearray()
             for key, value in pairs:
                 self._data[key] = value

@@ -76,41 +76,54 @@ class TxStore:
         vote_set: TxVoteSet,
         commit: Commit | None = None,
         votes: list[TxVote] | None = None,
+        tx: bytes | None = None,
     ) -> None:
         """votes: the caller's already-materialized vote_set.get_votes()
-        copy, so the commit path doesn't re-copy the set (r3 profile)."""
+        copy, so the commit path doesn't re-copy the set (r3 profile).
+        tx: the raw tx bytes when the caller has them — stored under T:
+        so a catch-up server can hand a wiped peer the bytes needed to
+        re-derive app state (sync/)."""
         if vote_set is None:
             raise ValueError("TxStore can only save a non-nil TxVoteSet")
         with self._mtx:
-            rows, sync = self._rows_for(vote_set, commit, votes)
+            rows, sync = self._rows_for(vote_set, commit, votes, tx)
             self.db.set_many(rows, sync=sync)  # txlint: allow(lock-blocking) -- _mtx IS the store's durability point: certificate rows must hit the db in commit order
 
     def save_txs_batch(
-        self, items: list[tuple[TxVoteSet, list[TxVote] | None]]
+        self, items: list[tuple]
     ) -> None:
         """Certificate rows for a whole committer wake in ONE db write
         group: one store lock, one backend lock / appended buffer / fsync
         (r4 profile: ~6 locked db ops per commit serialized the committer
         thread). Row content and ordering are identical to per-item
-        save_tx calls."""
+        save_tx calls. Items are (vote_set, votes) or
+        (vote_set, votes, tx_bytes) tuples."""
         if not items:
             return
         with self._mtx:
             rows: list[tuple[bytes, bytes]] = []
             sync = False
-            for vote_set, votes in items:
+            for item in items:
+                vote_set, votes = item[0], item[1]
+                tx = item[2] if len(item) > 2 else None
                 if vote_set is None:
                     raise ValueError("TxStore can only save a non-nil TxVoteSet")
-                r, s = self._rows_for(vote_set, None, votes)
+                r, s = self._rows_for(vote_set, None, votes, tx)
                 rows.extend(r)
                 sync = sync or s
             self.db.set_many(rows, sync=sync)  # txlint: allow(lock-blocking) -- _mtx IS the store's durability point: certificate rows must hit the db in commit order
+
+    def save_tx_bytes(self, tx_hash: str, tx: bytes) -> None:
+        """Late tx-bytes row for a certificate saved before the bytes
+        arrived (deferred-apply resolution)."""
+        self.db.set(b"T:" + tx_hash.encode(), tx)
 
     def _rows_for(
         self,
         vote_set: TxVoteSet,
         commit: Commit | None,
         votes: list[TxVote] | None,
+        tx: bytes | None = None,
     ) -> tuple[list[tuple[bytes, bytes]], bool]:
         """Rows for one certificate (call under self._mtx). Returns
         (rows, needs_fsync) — fsync when the height watermark advanced
@@ -120,6 +133,8 @@ class TxStore:
             votes = vote_set.get_votes()
         hash_b = tx_hash.encode()
         rows: list[tuple[bytes, bytes]] = [(b"H:" + hash_b, _encode_votes(votes))]
+        if tx is not None:
+            rows.append((b"T:" + hash_b, tx))
         if commit is None and vote_set.has_two_thirds_majority():
             # the commit certificate is exactly the set's votes (a
             # TxVoteSet only ever holds votes for its own tx), so the
@@ -199,3 +214,33 @@ class TxStore:
         for _, v in self.db.iterate(b"S:", b"S;"):
             out.append(v.decode())
         return out
+
+    # -- catch-up sync reads (sync/reactor.py serves from these) --
+
+    def seq_count(self) -> int:
+        """Number of fast-path commits in the order log — the node's
+        advertised sync height."""
+        with self._mtx:
+            return self._seq
+
+    def committed_range(self, start: int, count: int) -> list[tuple[int, str]]:
+        """(seq, tx_hash) pairs from the commit-order log, seq in
+        [start, start+count). Missing seqs (none in normal operation)
+        are simply absent from the result."""
+        if count <= 0 or start < 0:
+            return []
+        out: list[tuple[int, str]] = []
+        lo = b"S:%016d" % start
+        hi = b"S:%016d" % (start + count)
+        for k, v in self.db.iterate(lo, hi):
+            out.append((int(k[2:]), v.decode()))
+        return out
+
+    def load_cert_row(self, tx_hash: str) -> bytes | None:
+        """The raw H: certificate row, byte-identical to what this node
+        committed — sync serves this blob verbatim so a recovering peer
+        re-derives the exact same rows (_encode_votes is deterministic)."""
+        return self.db.get(_tx_key(tx_hash))
+
+    def load_tx_bytes(self, tx_hash: str) -> bytes | None:
+        return self.db.get(b"T:" + tx_hash.encode())

@@ -1,0 +1,8 @@
+"""Catch-up sync: wiped, lagging, and freshly-joined nodes recover the
+committed set from peers (see sync/manager.py for the design)."""
+
+from .config import SyncConfig
+from .manager import SyncManager
+from .reactor import CHANNEL_SYNC, SyncReactor
+
+__all__ = ["SyncConfig", "SyncManager", "SyncReactor", "CHANNEL_SYNC"]

@@ -1,0 +1,58 @@
+"""SyncConfig: catch-up client/server knobs (sync/manager.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class SyncConfig:
+    # -- lag detection --
+    # the client considers itself behind when the best peer advert exceeds
+    # its own commit-order seq count by at least this many commits. 1 =
+    # any gap; production nets want a few so transient gossip skew doesn't
+    # trigger a fetch round.
+    lag_threshold: int = 1
+    # how often the idle client re-evaluates peer adverts for lag
+    poll_interval: float = 0.25
+    # how often the server side re-advertises its seq count to every peer
+    status_interval: float = 0.5
+
+    # -- fetch pipeline --
+    # commits per range request; the server additionally bounds response
+    # size by max_resp_bytes
+    batch: int = 64
+    # bounded in-flight window: at most this many outstanding range
+    # requests to the serving peer (backpressure — a flood of responses
+    # can never queue unbounded work on the recovering node)
+    window: int = 4
+    # server-side hard cap on commits per response, independent of what
+    # the client asked for
+    max_range: int = 256
+    max_resp_bytes: int = 512 * 1024
+
+    # -- failure handling --
+    # per-request timeout before the request is considered stalled
+    request_timeout: float = 1.0
+    # jittered exponential backoff between retry rounds after a stall /
+    # Byzantine strike: base * 2^level, capped, +/- jitter fraction
+    backoff_base: float = 0.25
+    backoff_cap: float = 5.0
+    backoff_jitter: float = 0.25
+    # deterministic jitter stream
+    seed: int = 0
+    # score penalty handed to PeerScoreBoard.punish on a Byzantine strike
+    # (forged certificate / wrong epoch snapshot / truncated range) —
+    # sized to cross the default score floor (-8) in one strike, because
+    # one forged certificate is proof, not noise
+    byzantine_penalty: float = 16.0
+    # milder penalty for stalls/timeouts (could be the network's fault)
+    stall_penalty: float = 2.0
+    # local re-selection ban after a Byzantine strike, independent of
+    # scoreboard eviction (covers the health-layer-off configuration)
+    byzantine_ban: float = 30.0
+    # after this many consecutive failed rounds across ALL candidate
+    # peers, the client degrades to the consensus-block fallback state
+    # and waits fallback_cooldown before probing again
+    max_rounds: int = 3
+    fallback_cooldown: float = 5.0

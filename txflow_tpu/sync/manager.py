@@ -1,0 +1,558 @@
+"""SyncManager: the catch-up client state machine.
+
+A node detects it is behind from peers' STATUS adverts (commit-order seq
+count, served by every node's SyncReactor), selects a serving peer —
+highest advert, PeerScoreBoard score as tie-break, minus locally banned
+peers — and fetches ranges of committed txs + their 2n/3 certificates
+with a bounded in-flight window. Every fetched certificate is
+re-verified through the scalar/batched verifier path against the
+validator set the CLIENT has on record for the votes' height (never the
+server's claimed snapshot — that is only cross-checked, and a mismatch
+is a Byzantine strike) before being applied through the engine's commit
+seam (TxFlow.apply_synced_commit): never trusted, always re-derived.
+
+Failure handling, per the robustness contract (ISSUE 9):
+
+- per-request timeout -> stall strike, jittered exponential backoff,
+  peer rotation;
+- bounded window: at most ``window`` outstanding requests, so a flood
+  of responses can never queue unbounded verify/apply work;
+- Byzantine servers (forged certificate, wrong epoch snapshot,
+  truncated range, tx bytes that don't hash to the certified tx_hash)
+  are detected, punished through PeerScoreBoard.punish (crossing the
+  score floor evicts), banned locally, and rotated away from — the
+  recovering node's state is never poisoned because nothing is applied
+  before verification;
+- when every candidate peer fails ``max_rounds`` consecutive rounds the
+  client degrades to the consensus-block fallback state (the block
+  reactor's catch-up replay remains the recovery path of last resort),
+  surfaced via txflow_sync_state and /health, and probes again after
+  ``fallback_cooldown``.
+
+Ordering: there is no global total order across fast-path nodes (each
+node's commit-order log is its own decision order), so ranges are
+fetched in ONE server's seq space per round and applied in that order;
+a server switch restarts the walk where needed, with already-committed
+entries skipped cheaply before verification (dedup via TxStore). A
+lagging-but-not-wiped node first tries a tail round near its own count
+and escalates to a full walk only if the tail round closes no lag.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import queue as _queue
+import random
+import threading
+
+import numpy as np
+
+from ..trace.tracer import (
+    NULL_TRACER,
+    SPAN_SYNC_APPLY,
+    SPAN_SYNC_FETCH,
+    SPAN_SYNC_VERIFY,
+)
+from ..types import TxVoteSet
+from ..types.tx_vote import sign_bytes_many
+from ..types.validator import ValidatorSet
+from ..utils.clock import monotonic
+from ..verifier import ScalarVoteVerifier
+from ..store.tx_store import _decode_votes
+from . import wire
+from .config import SyncConfig
+from .reactor import CHANNEL_SYNC
+
+# states (txflow_sync_state gauge values)
+STATE_IDLE = 0
+STATE_SYNCING = 1
+STATE_FALLBACK = 2
+
+_STATE_NAMES = {STATE_IDLE: "idle", STATE_SYNCING: "syncing", STATE_FALLBACK: "fallback"}
+
+
+class SyncError(Exception):
+    """One failed interaction with a serving peer."""
+
+    def __init__(self, msg: str, byzantine: bool = False):
+        super().__init__(msg)
+        self.byzantine = byzantine
+
+
+def _set_fingerprint(vs: ValidatorSet) -> tuple:
+    return tuple((v.address, v.voting_power) for v in vs)
+
+
+class SyncManager:
+    def __init__(
+        self,
+        chain_id: str,
+        tx_store,
+        txflow,
+        switch,
+        state_store=None,
+        config: SyncConfig | None = None,
+        scoreboard=None,  # PeerScoreBoard | None (health off -> None)
+        metrics=None,  # SyncMetrics | None
+        tracer=None,
+    ):
+        self.chain_id = chain_id
+        self.tx_store = tx_store
+        self.txflow = txflow
+        self.switch = switch
+        self.state_store = state_store
+        self.config = config or SyncConfig()
+        self.scoreboard = scoreboard
+        self.metrics = metrics
+        self.tracer = tracer or NULL_TRACER
+        self._rng = random.Random(self.config.seed)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._mtx = threading.Lock()
+        # peer node_id -> (advertised seq_count, advertised height)
+        self._adverts: dict[str, tuple[int, int]] = {}
+        self._banned: dict[str, float] = {}  # node_id -> ban expiry
+        self._resp_q: _queue.Queue = _queue.Queue()
+        self._req_id = 0
+        self._verifiers: dict[tuple, ScalarVoteVerifier] = {}
+        self.state = STATE_IDLE
+        self._consec_failed_rounds = 0
+        self._backoff_level = 0
+        self._cooldown_until = 0.0
+        self.last_server: str | None = None
+        self.last_error = ""
+        # counters mirrored into metrics when a registry is wired
+        self.stats = {
+            "rounds_ok": 0,
+            "rounds_failed": 0,
+            "fetched": 0,
+            "applied": 0,
+            "verify_failures": 0,
+            "byzantine_strikes": 0,
+            "timeouts": 0,
+            "rotations": 0,
+            "fallbacks": 0,
+            "served": 0,
+        }
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="sync-manager", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- reactor callbacks (peer recv threads) --
+
+    def note_status(self, node_id: str, seq_count: int, height: int) -> None:
+        with self._mtx:
+            self._adverts[node_id] = (seq_count, height)
+
+    def note_peer_gone(self, node_id: str) -> None:
+        with self._mtx:
+            self._adverts.pop(node_id, None)
+
+    def note_response(self, node_id: str, *resp) -> None:
+        self._resp_q.put((node_id, resp))
+
+    def note_served(self, n_entries: int) -> None:
+        self.stats["served"] += n_entries
+        if self.metrics is not None:
+            self.metrics.served_txs.add(n_entries)
+
+    # -- introspection (health registry / tests) --
+
+    def lag(self) -> int:
+        local = self.tx_store.seq_count()
+        best = self._best_advert()
+        return max(0, best - local)
+
+    def _best_advert(self) -> int:
+        with self._mtx:
+            if not self._adverts:
+                return 0
+            return max(seq for seq, _h in self._adverts.values())
+
+    def snapshot(self) -> dict:
+        with self._mtx:
+            adverts = dict(self._adverts)
+            banned = [n for n, t in self._banned.items() if t > monotonic()]
+        return {
+            "state": _STATE_NAMES.get(self.state, str(self.state)),
+            "lag": self.lag(),
+            "local_seq": self.tx_store.seq_count(),
+            "best_advert": max((s for s, _ in adverts.values()), default=0),
+            "peers_advertising": len(adverts),
+            "banned_peers": banned,
+            "last_server": self.last_server,
+            "last_error": self.last_error,
+            **self.stats,
+        }
+
+    # -- the state machine --
+
+    def _run(self) -> None:
+        cfg = self.config
+        while not self._stop.wait(cfg.poll_interval):
+            self._expire_bans()
+            if self.metrics is not None:
+                self.metrics.lag.set(self.lag())
+                self.metrics.state.set(self.state)
+            now = monotonic()
+            if self.state == STATE_FALLBACK and now < self._cooldown_until:
+                continue
+            if self.lag() < cfg.lag_threshold:
+                self._set_state(STATE_IDLE)
+                self._consec_failed_rounds = 0
+                self._backoff_level = 0
+                continue
+            self._set_state(STATE_SYNCING)
+            applied = self._sync_round()
+            if self._stop.is_set():
+                return
+            if applied > 0:
+                self.stats["rounds_ok"] += 1
+                self._consec_failed_rounds = 0
+                self._backoff_level = 0
+                continue
+            self._consec_failed_rounds += 1
+            self.stats["rounds_failed"] += 1
+            if self._consec_failed_rounds >= cfg.max_rounds:
+                # graceful degradation: no peer can serve us — fall back
+                # to the consensus-block path and probe again later
+                self._set_state(STATE_FALLBACK)
+                self.stats["fallbacks"] += 1
+                if self.metrics is not None:
+                    self.metrics.fallbacks.add(1)
+                self._cooldown_until = monotonic() + cfg.fallback_cooldown
+                self._consec_failed_rounds = 0
+                self._backoff_level = 0
+            else:
+                self._sleep_backoff()
+
+    def _set_state(self, state: int) -> None:
+        self.state = state
+        if self.metrics is not None:
+            self.metrics.state.set(state)
+
+    def _expire_bans(self) -> None:
+        now = monotonic()
+        with self._mtx:
+            for nid in [n for n, t in self._banned.items() if t <= now]:
+                del self._banned[nid]
+
+    def _sleep_backoff(self) -> None:
+        cfg = self.config
+        base = min(cfg.backoff_base * (2.0**self._backoff_level), cfg.backoff_cap)
+        jitter = 1.0 + cfg.backoff_jitter * (2.0 * self._rng.random() - 1.0)
+        self._backoff_level += 1
+        self._stop.wait(base * jitter)
+
+    def _select_peer(self):
+        """Best candidate: highest advertised seq count among connected,
+        non-banned peers; PeerScoreBoard score breaks ties."""
+        now = monotonic()
+        scores = self.scoreboard.scores() if self.scoreboard is not None else {}
+        with self._mtx:
+            adverts = dict(self._adverts)
+            banned = {n for n, t in self._banned.items() if t > now}
+        local = self.tx_store.seq_count()
+        best, best_key = None, None
+        for peer in self.switch.peers():
+            nid = peer.node_id
+            if nid in banned:
+                continue
+            adv = adverts.get(nid)
+            if adv is None or adv[0] <= local:
+                continue
+            key = (adv[0], scores.get(nid, 0.0))
+            if best_key is None or key > best_key:
+                best, best_key = peer, key
+        return best, (best_key[0] if best_key else 0)
+
+    def _sync_round(self) -> int:
+        """One fetch round against one serving peer. Returns the number
+        of txs newly applied (0 = the round failed or closed no gap)."""
+        cfg = self.config
+        peer, target = self._select_peer()
+        if peer is None:
+            self.last_error = "no servable peer"
+            return 0
+        self.last_server = peer.node_id
+        local = self.tx_store.seq_count()
+        # tail round first: start near our own count. If the orders have
+        # diverged enough that the tail closes nothing, the next round
+        # falls through to a full walk from 0 (dedup skips known txs).
+        start = max(0, local - cfg.batch) if self._consec_failed_rounds == 0 else 0
+        try:
+            return self._fetch_apply(peer, start, target)
+        except SyncError as e:
+            self.last_error = str(e)
+            self._strike(peer, e)
+            return 0
+
+    def _strike(self, peer, err: SyncError) -> None:
+        cfg = self.config
+        self.stats["rotations"] += 1
+        if self.metrics is not None:
+            self.metrics.rotations.add(1)
+        if err.byzantine:
+            self.stats["byzantine_strikes"] += 1
+            if self.metrics is not None:
+                self.metrics.byzantine_strikes.add(1)
+            with self._mtx:
+                self._banned[peer.node_id] = monotonic() + cfg.byzantine_ban
+            if self.scoreboard is not None:
+                self.scoreboard.punish(peer.node_id, cfg.byzantine_penalty)
+        else:
+            self.stats["timeouts"] += 1
+            if self.metrics is not None:
+                self.metrics.timeouts.add(1)
+            if self.scoreboard is not None:
+                self.scoreboard.punish(peer.node_id, cfg.stall_penalty)
+
+    # -- fetch + verify + apply --
+
+    def _next_req_id(self) -> int:
+        self._req_id += 1
+        return self._req_id
+
+    def _fetch_apply(self, peer, cursor: int, target: int) -> int:
+        """Windowed range fetch from ``peer`` over [cursor, target) of
+        ITS seq space, verifying and applying responses strictly in
+        range order. Raises SyncError on stall or Byzantine evidence."""
+        cfg = self.config
+        pending: dict[int, tuple[int, int, float]] = {}  # req_id -> (start, count, sent)
+        ready: dict[int, tuple] = {}  # start -> (advert, entries, snapshots, t_sent)
+        next_start = cursor
+        applied = 0
+        # drain stale responses from prior rounds
+        while not self._resp_q.empty():
+            try:
+                self._resp_q.get_nowait()
+            except _queue.Empty:
+                break
+        while (cursor < target or pending) and not self._stop.is_set():
+            while len(pending) < cfg.window and next_start < target:
+                count = min(cfg.batch, target - next_start)
+                rid = self._next_req_id()
+                if not peer.try_send(
+                    CHANNEL_SYNC, wire.encode_range_req(rid, next_start, count)
+                ):
+                    raise SyncError(f"send to {peer.node_id} failed")
+                pending[rid] = (next_start, count, monotonic())
+                next_start += count
+            try:
+                nid, resp = self._resp_q.get(timeout=self._wait_budget(pending))
+            except _queue.Empty:
+                raise SyncError(f"range request to {peer.node_id} timed out")
+            if nid != peer.node_id:
+                continue  # stale response from a rotated-away server
+            req_id, start, advert, entries, snapshots = resp
+            meta = pending.pop(req_id, None)
+            if meta is None:
+                continue  # duplicate/stale req_id
+            r_start, r_count, t_sent = meta
+            if start != r_start:
+                raise SyncError(
+                    f"{peer.node_id} answered start {start} for {r_start}",
+                    byzantine=True,
+                )
+            expected = min(r_count, max(advert, target) - r_start)
+            if len(entries) < expected:
+                raise SyncError(
+                    f"truncated range from {peer.node_id}: "
+                    f"{len(entries)} entries, expected {expected}",
+                    byzantine=True,
+                )
+            ready[r_start] = (r_count, entries, snapshots, t_sent)
+            # apply contiguously from the cursor (never out of order: the
+            # commit-order log must extend in the server's order)
+            while cursor in ready:
+                r_count, entries, snapshots, t_sent = ready.pop(cursor)
+                span_hash = self._first_sampled(entries)
+                if span_hash is not None:
+                    self.tracer.span(span_hash, SPAN_SYNC_FETCH, t_sent, monotonic())
+                applied += self._verify_apply(peer, entries, snapshots)
+                cursor += r_count
+        return applied
+
+    def _wait_budget(self, pending: dict) -> float:
+        """Time until the OLDEST outstanding request times out."""
+        if not pending:
+            return self.config.request_timeout
+        oldest = min(t for _s, _c, t in pending.values())
+        return max(0.01, oldest + self.config.request_timeout - monotonic())
+
+    def _first_sampled(self, entries: list) -> str | None:
+        tr = self.tracer
+        if not tr.active:
+            return None
+        for tx_hash, _cert, _tx in entries:
+            if tr.sampled(tx_hash):
+                return tx_hash
+        return None
+
+    def _own_vals_for(self, height: int) -> ValidatorSet:
+        vals = (
+            self.state_store.load_validators(height)
+            if self.state_store is not None
+            else None
+        )
+        if vals is None:
+            vals = self.txflow.val_set
+        return vals
+
+    def _verifier_for(self, vals: ValidatorSet) -> ScalarVoteVerifier:
+        fp = _set_fingerprint(vals)
+        v = self._verifiers.get(fp)
+        if v is None:
+            if len(self._verifiers) > 8:
+                self._verifiers.clear()  # epoch churn: keep the cache tiny
+            v = self._verifiers[fp] = ScalarVoteVerifier(vals)
+        return v
+
+    def _verify_apply(self, peer, entries: list, snapshots: dict) -> int:
+        """Verify one response's certificates (batched, grouped by the
+        validator set in force at their height) and apply them in order.
+        Raises SyncError(byzantine=True) on any forged content."""
+        if not entries:
+            return 0
+        nid = peer.node_id
+        t_verify0 = monotonic()
+        parsed = []  # (tx_hash, votes, tx, tx_key, vals) in response order
+        for tx_hash, cert_blob, tx in entries:
+            if self.tx_store.has_tx(tx_hash):
+                parsed.append(None)  # dedup: already committed locally
+                continue
+            tx_key = hashlib.sha256(tx).digest()
+            if tx_key.hex().upper() != tx_hash:
+                raise SyncError(
+                    f"{nid} served tx bytes that hash to "
+                    f"{tx_key.hex().upper()[:12]}.., certified {tx_hash[:12]}..",
+                    byzantine=True,
+                )
+            try:
+                votes = _decode_votes(cert_blob)
+            except Exception:
+                raise SyncError(f"{nid} served an undecodable certificate", byzantine=True)
+            if not votes:
+                raise SyncError(f"{nid} served an empty certificate", byzantine=True)
+            for v in votes:
+                # sign bytes zero TxKey (types.tx_vote): the vote's own
+                # hash/key fields are forgeable without breaking the
+                # signature — bind them to the tx bytes we derived
+                if v.tx_hash != tx_hash or v.tx_key != tx_key:
+                    raise SyncError(
+                        f"{nid} served a certificate whose votes name a "
+                        "different tx",
+                        byzantine=True,
+                    )
+            height = votes[0].height
+            vals = self._own_vals_for(height)
+            claimed = snapshots.get(height)
+            if claimed is not None and _set_fingerprint(claimed) != _set_fingerprint(
+                vals
+            ):
+                # wrong epoch snapshot: the server claims these votes were
+                # cast under a different validator set than OUR record for
+                # that height — verification always uses our record, so
+                # the lie cannot poison state, but it is still proof of a
+                # bad server
+                raise SyncError(
+                    f"{nid} claims a different validator set at height {height}",
+                    byzantine=True,
+                )
+            parsed.append((tx_hash, votes, tx, tx_key, vals))
+        # batched verify, grouped by validator set (one group per epoch)
+        groups: dict[tuple, list[int]] = {}
+        for i, p in enumerate(parsed):
+            if p is None:
+                continue
+            groups.setdefault(_set_fingerprint(p[4]), []).append(i)
+        for fp, idxs in groups.items():
+            vals = parsed[idxs[0]][4]
+            verifier = self._verifier_for(vals)
+            addr_to_idx = {v.address: j for j, v in enumerate(vals)}
+            msgs: list[bytes] = []
+            sigs: list[bytes] = []
+            val_idx: list[int] = []
+            tx_slot: list[int] = []
+            for slot, i in enumerate(idxs):
+                _h, votes, _tx, _k, _vals = parsed[i]
+                vb = sign_bytes_many(votes, self.chain_id)
+                for v, sb in zip(votes, vb):
+                    vi = addr_to_idx.get(v.validator_address)
+                    if vi is None:
+                        raise SyncError(
+                            f"{nid} certificate carries a vote from an "
+                            "unknown validator",
+                            byzantine=True,
+                        )
+                    msgs.append(sb)
+                    sigs.append(v.signature or b"")
+                    val_idx.append(vi)
+                    tx_slot.append(slot)
+            res = verifier.verify_and_tally(
+                msgs,
+                sigs,
+                np.asarray(val_idx, dtype=np.int32),
+                np.asarray(tx_slot, dtype=np.int32),
+                n_slots=len(idxs),
+                quorum=vals.quorum_power(),
+            )
+            if not bool(res.valid.all()):
+                self.stats["verify_failures"] += 1
+                if self.metrics is not None:
+                    self.metrics.verify_failures.add(1)
+                raise SyncError(
+                    f"{nid} served a certificate with an invalid signature",
+                    byzantine=True,
+                )
+            if bool(res.dropped.any()):
+                raise SyncError(
+                    f"{nid} served a certificate with duplicate votes",
+                    byzantine=True,
+                )
+            if not bool(res.maj23.all()):
+                self.stats["verify_failures"] += 1
+                if self.metrics is not None:
+                    self.metrics.verify_failures.add(1)
+                raise SyncError(
+                    f"{nid} served a certificate below 2/3+ stake",
+                    byzantine=True,
+                )
+        span_hash = self._first_sampled(entries)
+        if span_hash is not None:
+            self.tracer.span(span_hash, SPAN_SYNC_VERIFY, t_verify0, monotonic())
+        # verified: apply in the server's order through the commit seam
+        applied = 0
+        fetched = sum(1 for p in parsed if p is not None)
+        self.stats["fetched"] += fetched
+        if self.metrics is not None:
+            self.metrics.ranges_fetched.add(1)
+            self.metrics.txs_fetched.add(fetched)
+        for p in parsed:
+            if p is None:
+                continue
+            tx_hash, votes, tx, tx_key, vals = p
+            t0 = monotonic()
+            vs = TxVoteSet(self.chain_id, votes[0].height, tx_hash, tx_key, vals)
+            for v in votes:
+                vs.add_verified_vote(v)
+            if self.txflow.apply_synced_commit(vs, votes, tx):
+                applied += 1
+                if self.tracer.active and self.tracer.sampled(tx_hash):
+                    self.tracer.span(tx_hash, SPAN_SYNC_APPLY, t0, monotonic())
+        self.stats["applied"] += applied
+        if self.metrics is not None:
+            self.metrics.txs_applied.add(applied)
+        return applied

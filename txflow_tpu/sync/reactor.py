@@ -1,0 +1,156 @@
+"""SyncReactor: the catch-up channel's server + transport glue.
+
+Server half (every node): periodically adverts its commit-order seq
+count (STATUS) so lagging peers can find it, and answers RANGE_REQ with
+ranges of committed txs + their certificates + the validator-set
+snapshots needed to verify them at the heights they were cast
+(RANGE_RESP). Serving is read-only and bounded (max_range commits /
+max_resp_bytes per response) so a flood of sync requests can't starve
+the fast path.
+
+Client half: STATUS and RANGE_RESP frames are handed to the
+SyncManager (manager.py), which runs the lag detector / fetch state
+machine on its own thread — the peer recv loop never does certificate
+verification or ABCI applies.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..p2p.base import CHANNEL_SYNC, ChannelDescriptor, Reactor
+from ..store.tx_store import _decode_votes
+from . import wire
+from .config import SyncConfig
+
+
+class SyncReactor(Reactor):
+    def __init__(
+        self,
+        tx_store,
+        state_store=None,
+        current_vals=None,  # () -> ValidatorSet: fallback snapshot source
+        config: SyncConfig | None = None,
+    ):
+        super().__init__("sync")
+        self.tx_store = tx_store
+        self.state_store = state_store
+        self.current_vals = current_vals
+        self.config = config or SyncConfig()
+        self.manager = None  # SyncManager, wired by the node (client half)
+        self._stop = threading.Event()
+        # Byzantine-server test hook: callable(entries, snapshots) ->
+        # (entries, snapshots) applied to every response before encode.
+        # Drills use it to forge certificates / epoch snapshots /
+        # truncate ranges from an otherwise-honest node.
+        self.tamper = None
+        self.served_ranges = 0
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        # responses carry up to max_range certificates + tx bytes: give
+        # the channel headroom over the spec'd response cap
+        return [
+            ChannelDescriptor(
+                id=CHANNEL_SYNC,
+                priority=2,
+                recv_message_capacity=max(
+                    2 * 1024 * 1024, 2 * self.config.max_resp_bytes
+                ),
+            )
+        ]
+
+    def on_start(self) -> None:
+        self._stop.clear()
+        threading.Thread(
+            target=self._status_loop, name="sync-status", daemon=True
+        ).start()
+
+    def on_stop(self) -> None:
+        self._stop.set()
+
+    def add_peer(self, peer) -> None:
+        peer.try_send(
+            CHANNEL_SYNC,
+            wire.encode_status(self.tx_store.seq_count(), self.tx_store.height()),
+        )
+
+    def remove_peer(self, peer, reason: object = None) -> None:
+        if self.manager is not None:
+            self.manager.note_peer_gone(peer.node_id)
+
+    def receive(self, chan_id: int, peer, msg: bytes) -> None:
+        if not msg:
+            raise ValueError("empty sync frame")
+        tag = msg[0]
+        if tag == wire.MSG_STATUS:
+            seq_count, height = wire.decode_status(msg)
+            if self.manager is not None:
+                self.manager.note_status(peer.node_id, seq_count, height)
+        elif tag == wire.MSG_RANGE_REQ:
+            req_id, start, count = wire.decode_range_req(msg)
+            peer.try_send(CHANNEL_SYNC, self._serve_range(req_id, start, count))
+        elif tag == wire.MSG_RANGE_RESP:
+            resp = wire.decode_range_resp(msg)
+            if self.manager is not None:
+                self.manager.note_response(peer.node_id, *resp)
+        else:
+            # unknown tag: a peer speaking a different protocol version
+            # (or garbage) — decode error semantics, switch stops the peer
+            raise ValueError(f"unknown sync tag {tag}")
+
+    # -- server --
+
+    def _serve_range(self, req_id: int, start: int, count: int) -> bytes:
+        cfg = self.config
+        advert = self.tx_store.seq_count()
+        count = max(0, min(count, cfg.max_range))
+        entries: list[tuple[str, bytes, bytes]] = []
+        snapshots: dict[int, object] = {}
+        size = 0
+        for _seq, tx_hash in self.tx_store.committed_range(start, count):
+            cert = self.tx_store.load_cert_row(tx_hash)
+            tx = self.tx_store.load_tx_bytes(tx_hash)
+            if cert is None or tx is None:
+                # pre-T:-row history, or rows lost to corruption: stop the
+                # range here; the client treats a short response honestly
+                # only up to what we can actually prove, and its
+                # advert-vs-entries check is keyed on OUR advert below
+                advert = min(advert, _seq)
+                break
+            size += len(cert) + len(tx)
+            if entries and size > cfg.max_resp_bytes:
+                break
+            entries.append((tx_hash, cert, tx))
+            try:
+                h = _decode_votes(cert)[0].height
+            except Exception:
+                h = 0
+            if h not in snapshots:
+                vals = (
+                    self.state_store.load_validators(h)
+                    if self.state_store is not None
+                    else None
+                )
+                if vals is None and self.current_vals is not None:
+                    vals = self.current_vals()
+                if vals is not None:
+                    snapshots[h] = vals
+        if self.tamper is not None:
+            entries, snapshots = self.tamper(entries, snapshots)
+        self.served_ranges += 1
+        if self.manager is not None:
+            self.manager.note_served(len(entries))
+        return wire.encode_range_resp(req_id, start, advert, entries, snapshots)
+
+    # -- status adverts --
+
+    def _status_loop(self) -> None:
+        while not self._stop.wait(self.config.status_interval):
+            sw = self.switch
+            if sw is None:
+                continue
+            frame = wire.encode_status(
+                self.tx_store.seq_count(), self.tx_store.height()
+            )
+            for peer in sw.peers():
+                peer.try_send(CHANNEL_SYNC, frame)

@@ -45,12 +45,18 @@ SPAN_PREP = "host_prep"
 SPAN_DEVICE = "device_verify"
 SPAN_QUORUM = "quorum_latch"
 SPAN_COMMIT = "commit_apply"
+# catch-up sync (sync/): fetch = request sent -> response received,
+# verify = certificate batch re-verification, apply = commit-seam apply
+SPAN_SYNC_FETCH = "sync_fetch"
+SPAN_SYNC_VERIFY = "sync_verify"
+SPAN_SYNC_APPLY = "sync_apply"
 SPAN_E2E = "e2e"
 
 SPAN_ORDER = (
     SPAN_ADMISSION, SPAN_TX_INGEST, SPAN_GOSSIP_INGEST, SPAN_SIGN,
     SPAN_VOTE_INGEST, SPAN_LOCK_WAIT, SPAN_LINGER, SPAN_PREP,
-    SPAN_DEVICE, SPAN_QUORUM, SPAN_COMMIT, SPAN_E2E,
+    SPAN_DEVICE, SPAN_QUORUM, SPAN_COMMIT, SPAN_SYNC_FETCH,
+    SPAN_SYNC_VERIFY, SPAN_SYNC_APPLY, SPAN_E2E,
 )
 
 

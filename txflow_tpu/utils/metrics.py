@@ -288,6 +288,12 @@ class AdmissionMetrics:
         # what the token bucket is actually refilling at RIGHT NOW
         self.bulk_rate_effective = r.gauge("admission", "bulk_rate_effective", "current bulk token-bucket fill rate (tx/s)")
         self.commit_rate = r.gauge("admission", "commit_rate_observed", "EWMA of the engine commit rate the bulk bucket tracks (tx/s)")
+        # per-sender fairness inside the priority lane (ISSUE 9 satellite,
+        # closing the PR 6 follow-up): one sender flooding fee-bearing txs
+        # must not starve other priority senders
+        self.priority_sender_limited = r.counter("admission", "priority_sender_limited", "priority txs past their sender's token budget (demoted to bulk shed rules)")
+        self.priority_sender_shed = r.counter("admission", "priority_sender_shed", "over-budget priority txs shed at the RPC edge (429)")
+        self.priority_sender_tracked = r.gauge("admission", "priority_sender_tracked", "distinct priority senders in the fairness table")
 
 
 class EpochMetrics:
@@ -312,6 +318,30 @@ class EpochMetrics:
         self.pending_slashes = r.gauge("epoch", "pending_slashes", "offenders awaiting the next boundary")
 
 
+class SyncMetrics:
+    """Catch-up sync metrics (sync/ subsystem, ``txflow_sync_*``).
+
+    The lag gauge and state gauge (0 idle / 1 syncing / 2 fallback) are
+    the operator's first look at a recovering node; the Byzantine /
+    timeout counters tell WHY a node keeps rotating servers. Server-side
+    ``served_txs`` rides the same registry so one exposition shows both
+    halves."""
+
+    def __init__(self, registry: "Registry | None" = None):
+        r = registry or GLOBAL
+        self.lag = r.gauge("sync", "lag", "commits the best peer advert is ahead of us")
+        self.state = r.gauge("sync", "state", "0=idle 1=syncing 2=consensus-block fallback")
+        self.ranges_fetched = r.counter("sync", "ranges_fetched", "range responses verified and applied")
+        self.txs_fetched = r.counter("sync", "txs_fetched", "committed txs fetched from peers (post-dedup)")
+        self.txs_applied = r.counter("sync", "txs_applied", "fetched txs applied through the commit seam")
+        self.verify_failures = r.counter("sync", "verify_failures", "fetched certificates failing re-verification")
+        self.byzantine_strikes = r.counter("sync", "byzantine_strikes", "sync servers caught serving forged/truncated data")
+        self.timeouts = r.counter("sync", "timeouts", "range requests that stalled past the timeout")
+        self.rotations = r.counter("sync", "rotations", "serving-peer rotations (stall or strike)")
+        self.fallbacks = r.counter("sync", "fallbacks", "degradations to the consensus-block fallback")
+        self.served_txs = r.counter("sync", "served_txs", "committed txs this node served to catching-up peers")
+
+
 class TxFlowMetrics:
     """Fast-path metrics (reference txflowstate/metrics.go:17-45)."""
 
@@ -323,6 +353,9 @@ class TxFlowMetrics:
         self.verified_votes = r.counter("txflow", "verified_votes", "signatures batch-verified")
         self.invalid_votes = r.counter("txflow", "invalid_votes", "votes failing verification")
         self.batch_size = r.histogram("txflow", "batch_size", "device batch occupancy", buckets=(64, 256, 1024, 4096, 16384, 65536))
+        # durable-path degradation (disk full / EIO): commits stay applied
+        # in memory, the failure is surfaced loudly here + /health
+        self.storage_errors = r.counter("txflow", "storage_errors", "durable writes failed (ENOSPC/EIO) — node degraded, not crashed")
         self.step_time = r.histogram("txflow", "step_seconds", "aggregation step wall time")
         self.tx_processing_time = r.histogram("txflow", "tx_processing_seconds", "ApplyTx wall time")
         # verify-pipeline observability (engine pipelined loop): depth is

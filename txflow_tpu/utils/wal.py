@@ -15,6 +15,8 @@ import struct
 import zlib
 from typing import Iterator
 
+from .failpoints import fail
+
 _HDR = struct.Struct("<II")
 
 
@@ -26,6 +28,7 @@ class WAL:
         self._f = open(path, "ab")
 
     def write(self, payload: bytes) -> None:
+        fail("wal.write")  # ENOSPC/EIO drills (tests/test_diskfull.py)
         frame = _HDR.pack(zlib.crc32(payload), len(payload)) + payload
         self._f.write(frame)
         if self.sync_on_write:
