@@ -17,12 +17,16 @@ import hashlib
 import os
 import time
 
+import pytest
+
 from txflow_tpu.faults.plan import FaultSpec, GOSSIP_CHANNELS, SYNC_CHANNELS
 from txflow_tpu.node.localnet import LocalNet
+from txflow_tpu.state.store import StateStore
 from txflow_tpu.store.db import MemDB
-from txflow_tpu.store.tx_store import TxStore
+from txflow_tpu.store.tx_store import TxStore, _encode_votes
 from txflow_tpu.sync import wire
 from txflow_tpu.sync.config import SyncConfig
+from txflow_tpu.sync.manager import SyncError, SyncManager
 from txflow_tpu.types import MockPV, TxVote, TxVoteSet, Validator, ValidatorSet
 
 
@@ -448,6 +452,193 @@ def test_lagging_node_catches_up_without_wipe(tmp_path):
         assert _wait_has_all(node3, want, 45), node3.sync_manager.snapshot()
     finally:
         net.stop()
+
+
+# -- honest short responses: resume, never strike --
+
+
+def test_honest_byte_capped_responses_resume(tmp_path):
+    """A max_resp_bytes small enough that every honest response is
+    byte-capped to ~1 entry: the client must treat the short prefixes as
+    progress and resume, NOT strike the honest servers Byzantine (which
+    used to ban every peer in turn and wedge sync in fallback)."""
+    net = LocalNet(
+        4,
+        use_device_verifier=False,
+        enable_consensus=False,
+        sync_config=_fast_sync_cfg(max_resp_bytes=256),
+    )
+    net.make_durable(3, str(tmp_path / "node3"))
+    net.start()
+    try:
+        txs = [b"fee=1;cap-%d=v" % i for i in range(20)]
+        _commit_set(net, txs)
+        net.crash_node(3)
+        net.wipe_node(3)
+        node3 = net.revive_node(3)
+        want = [hashlib.sha256(t).hexdigest().upper() for t in txs]
+        assert _wait_has_all(node3, want, 45), node3.sync_manager.snapshot()
+        snap = node3.sync_manager.snapshot()
+        assert snap["byzantine_strikes"] == 0, snap
+        assert snap["banned_peers"] == [], snap
+    finally:
+        net.stop()
+
+
+# -- unit rigs: manager verify / lag internals --
+
+
+class _StubTxFlow:
+    def __init__(self, vals):
+        self.val_set = vals
+        self.applied = []
+
+    def apply_synced_commit(self, vs, votes, tx):
+        self.applied.append(vs.tx_hash)
+        return True
+
+
+class _StubPeer:
+    def __init__(self, node_id="server"):
+        self.node_id = node_id
+
+
+def _val_set(tag, n, power=10):
+    pvs = [MockPV(hashlib.sha256(b"%s-%d" % (tag, i)).digest()) for i in range(n)]
+    vals = ValidatorSet(
+        [Validator.from_pub_key(pv.get_pub_key(), power) for pv in pvs]
+    )
+    return pvs, vals
+
+
+def _signed_votes(chain_id, pvs, tx, height):
+    key = hashlib.sha256(tx).digest()
+    votes = []
+    for pv in pvs:
+        v = TxVote(
+            height=height,
+            tx_hash=key.hex().upper(),
+            tx_key=key,
+            validator_address=pv.get_address(),
+        )
+        pv.sign_tx_vote(chain_id, v)
+        votes.append(v)
+    return votes
+
+
+def _entry(chain_id, pvs, tx, height):
+    """One served (tx_hash, cert_blob, tx) triple signed at ``height``."""
+    votes = _signed_votes(chain_id, pvs, tx, height)
+    return (votes[0].tx_hash, _encode_votes(votes), tx)
+
+
+def _unit_manager(vals, state_store=None):
+    return SyncManager(
+        "unit-chain",
+        TxStore(MemDB()),
+        _StubTxFlow(vals),
+        switch=None,
+        state_store=state_store,
+        config=SyncConfig(),
+    )
+
+
+def test_lag_ignores_banned_peer_adverts():
+    """A Byzantine-struck peer's inflated advert must stop counting
+    toward lag() — otherwise one liar advertising 2^60 keeps the node
+    cycling syncing->fallback (and /health unhealthy) forever."""
+    _pvs, vals = _val_set(b"lagv", 1)
+    mgr = _unit_manager(vals)
+    mgr.note_status("liar", 2**60, 0)
+    mgr.note_status("honest", 5, 0)
+    assert mgr.lag() == 2**60
+    mgr._strike(_StubPeer("liar"), SyncError("forged", byzantine=True))
+    # the liar's advert is dropped and its ban excludes any re-advert
+    assert mgr.lag() == 5
+    mgr.note_status("liar", 2**60, 0)  # re-advert while banned: ignored
+    assert mgr.lag() == 5
+    snap = mgr.snapshot()
+    assert snap["best_advert"] == 5
+    assert "liar" in snap["banned_peers"]
+
+
+def test_mixed_height_certificate_is_byzantine():
+    """votes[0].height selects the validator set: a certificate mixing
+    vote heights could tally other-height votes under the wrong stake
+    weights, so it must be rejected as a strike, not verified."""
+    pvs, vals = _val_set(b"mixv", 4)
+    mgr = _unit_manager(vals)
+    tx = b"mixed=v"
+    votes = _signed_votes("unit-chain", pvs[:2], tx, height=3)
+    votes += _signed_votes("unit-chain", pvs[2:], tx, height=4)
+    entry = (votes[0].tx_hash, _encode_votes(votes), tx)
+    with pytest.raises(SyncError) as ei:
+        mgr._verify_apply(_StubPeer(), [entry], {})
+    assert ei.value.byzantine
+    assert "mixing vote heights" in str(ei.value)
+    assert mgr.txflow.applied == []
+
+
+# -- epoch-crossing recovery: trust-chain snapshot verification --
+
+
+def test_fresh_node_verifies_under_endorsed_snapshot():
+    """A wiped/fresh node with no record for a height verifies under the
+    server's snapshot when the certificate's proven signers carry a 2/3
+    quorum of the set it does trust — and pins the learned set (memory +
+    state store) so later heights resolve as its own record."""
+    old_pvs, old_vals = _val_set(b"epoch-old", 4)
+    # rotate ONE validator out: 3/4 of the old set's power still signs,
+    # above the old set's 2/3 quorum -> the transition is endorsed
+    new_pvs = old_pvs[:3] + _val_set(b"epoch-new", 1)[0]
+    new_vals = ValidatorSet(
+        [Validator.from_pub_key(pv.get_pub_key(), 10) for pv in new_pvs]
+    )
+    state_store = StateStore(MemDB())
+    mgr = _unit_manager(old_vals, state_store=state_store)
+    tx = b"rotated=v"
+    entry = _entry("unit-chain", new_pvs, tx, height=7)
+    applied = mgr._verify_apply(_StubPeer(), [entry], {7: new_vals})
+    assert applied == 1
+    assert mgr.txflow.applied == [entry[0]]
+    # learned + persisted: height 7 now resolves locally
+    pinned = state_store.load_validators(7)
+    assert pinned is not None
+    assert [(v.address, v.voting_power) for v in pinned] == [
+        (v.address, v.voting_power) for v in new_vals
+    ]
+    vals7, on_record = mgr._vals_for(7)
+    assert on_record
+
+
+def test_fresh_node_rejects_unendorsed_snapshot():
+    """A snapshot whose signers share no stake with any set we trust is
+    refused — but NOT as a Byzantine strike (our record may merely be
+    stale), so the round fails toward rotation/fallback and nothing is
+    applied."""
+    _old_pvs, old_vals = _val_set(b"anchor", 4)
+    evil_pvs, evil_vals = _val_set(b"usurper", 4)
+    mgr = _unit_manager(old_vals)
+    entry = _entry("unit-chain", evil_pvs, b"usurped=v", height=7)
+    with pytest.raises(SyncError) as ei:
+        mgr._verify_apply(_StubPeer(), [entry], {7: evil_vals})
+    assert not ei.value.byzantine
+    assert "endorse" in str(ei.value)
+    assert mgr.txflow.applied == []
+
+
+def test_snapshot_mismatch_against_own_record_is_byzantine():
+    """When the client HAS a record for the height, a contradicting
+    server snapshot stays what it always was: proof of a bad server."""
+    old_pvs, old_vals = _val_set(b"record", 4)
+    _evil_pvs, evil_vals = _val_set(b"claimant", 4)
+    mgr = _unit_manager(old_vals)
+    mgr._trusted_vals[7] = old_vals  # our own record for the height
+    entry = _entry("unit-chain", old_pvs, b"recorded=v", height=7)
+    with pytest.raises(SyncError) as ei:
+        mgr._verify_apply(_StubPeer(), [entry], {7: evil_vals})
+    assert ei.value.byzantine
+    assert mgr.txflow.applied == []
 
 
 # -- sync-only chaos scoping (satellite: FaultSpec.sync_only) --

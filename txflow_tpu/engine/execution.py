@@ -13,7 +13,7 @@ import hashlib
 import time
 
 from ..abci.proxy import AppConnConsensus
-from ..analysis.lockgraph import sanctioned_blocking
+from ..analysis.lockgraph import make_lock, sanctioned_blocking
 from ..pool.mempool import Mempool
 from ..utils import failpoints
 from ..utils.events import EventBus, EventDataTx, EventTx
@@ -32,6 +32,16 @@ class TxExecutor:
         self.mempool = mempool
         self.event_bus = event_bus
         self.metrics = metrics or TxFlowMetrics()
+        # commit-seam mutex: one DeliverTx->Commit fence is the unit of
+        # atomicity against the app. The committer thread and the
+        # catch-up sync apply (TxFlow.apply_synced_commit, sync-manager
+        # thread) both land here on a lagging-but-live node; without the
+        # seam an interleaved DeliverTx can be committed under the OTHER
+        # thread's fence and both threads' app_hash attribution goes
+        # racy. Held across app round trips by design (allow_blocking).
+        self._seam_mtx = make_lock(
+            "engine.TxExecutor._seam_mtx", allow_blocking=True
+        )
         self._ev_thread = None  # lazy event worker (see _fire_events)
         self._ev_q = None
         # enqueue/publish accounting so events_drained() can say when
@@ -55,12 +65,13 @@ class TxExecutor:
         always does — tx_key IS the mempool key), skip a per-commit
         sha256+hexdigest in the event payload and the mempool purge."""
         t0 = time.perf_counter()
-        deliver_res = self._exec_tx_on_proxy_app(tx)
-        self.metrics.tx_processing_time.observe(time.perf_counter() - t0)
+        with self._seam_mtx:
+            deliver_res = self._exec_tx_on_proxy_app(tx)
+            self.metrics.tx_processing_time.observe(time.perf_counter() - t0)
 
-        failpoints.fail("txflow-before-commit")
+            failpoints.fail("txflow-before-commit")
 
-        app_hash = self._commit(height, tx, deliver_res, tx_key)
+            app_hash = self._commit(height, tx, deliver_res, tx_key)  # txlint: allow(lock-blocking) -- the seam mutex EXISTS to hold DeliverTx+Commit atomic against the sync-apply/committer race
 
         failpoints.fail("txflow-after-commit")
 
@@ -109,28 +120,30 @@ class TxExecutor:
         on Commit cadence (none of the bundled ones) must keep it at 1.
         Returns (app_hash, deliver_results)."""
         t0 = time.perf_counter()
-        # pipeline all DeliverTxs, fence once (.value per call would force
-        # a flush round-trip each over RemoteAppConns, r4 advisor)
-        pending = [self.proxy_app.deliver_tx_async(tx) for tx, _ in items]
-        self.proxy_app.flush()
-        results = [p.value for p in pending]
-        self.metrics.tx_processing_time.observe(time.perf_counter() - t0)
+        with self._seam_mtx:
+            # pipeline all DeliverTxs, fence once (.value per call would
+            # force a flush round-trip each over RemoteAppConns, r4
+            # advisor)
+            pending = [self.proxy_app.deliver_tx_async(tx) for tx, _ in items]
+            self.proxy_app.flush()
+            results = [p.value for p in pending]
+            self.metrics.tx_processing_time.observe(time.perf_counter() - t0)
 
-        failpoints.fail("txflow-before-commit")
+            failpoints.fail("txflow-before-commit")
 
-        self.mempool.lock()
-        try:
-            # same contract as _commit: the fence and the pool update are
-            # one atomic step with respect to CheckTx
-            with sanctioned_blocking("app-Commit fence atomic with mempool.update"):
-                self.proxy_app.flush()
-                commit_res = self.proxy_app.commit_sync()
-                self.mempool.update(
-                    height, [tx for tx, _ in items], results, keys=keys
-                )
-            app_hash = commit_res.data
-        finally:
-            self.mempool.unlock()
+            self.mempool.lock()
+            try:
+                # same contract as _commit: the fence and the pool update
+                # are one atomic step with respect to CheckTx
+                with sanctioned_blocking("app-Commit fence atomic with mempool.update"):
+                    self.proxy_app.flush()
+                    commit_res = self.proxy_app.commit_sync()  # txlint: allow(lock-blocking) -- the seam mutex EXISTS to hold DeliverTx+Commit atomic against the sync-apply/committer race
+                    self.mempool.update(
+                        height, [tx for tx, _ in items], results, keys=keys
+                    )
+                app_hash = commit_res.data
+            finally:
+                self.mempool.unlock()
 
         failpoints.fail("txflow-after-commit")
 

@@ -5,11 +5,19 @@ count, served by every node's SyncReactor), selects a serving peer —
 highest advert, PeerScoreBoard score as tie-break, minus locally banned
 peers — and fetches ranges of committed txs + their 2n/3 certificates
 with a bounded in-flight window. Every fetched certificate is
-re-verified through the scalar/batched verifier path against the
-validator set the CLIENT has on record for the votes' height (never the
-server's claimed snapshot — that is only cross-checked, and a mismatch
-is a Byzantine strike) before being applied through the engine's commit
-seam (TxFlow.apply_synced_commit): never trusted, always re-derived.
+re-verified through the scalar/batched verifier path before being
+applied through the engine's commit seam (TxFlow.apply_synced_commit):
+never trusted, always re-derived. The verification set for a height is
+the one the CLIENT has on record (state store / previously pinned); a
+server snapshot that contradicts a record is a Byzantine strike. When
+the client has NO record for a height (a wiped/fresh node recovering
+across epoch boundaries), it verifies under the server's claimed
+snapshot but ACCEPTS it only if the certificate's (signature-verified)
+signers carry a 2/3 quorum of the nearest set the client does trust —
+a light-client-style transition endorsement: honest validators only
+sign under the set they believe in force. Accepted snapshots are
+pinned locally and persisted, so later heights resolve as records of
+our own and restarts keep the chain of trust.
 
 Failure handling, per the robustness contract (ISSUE 9):
 
@@ -17,12 +25,21 @@ Failure handling, per the robustness contract (ISSUE 9):
   peer rotation;
 - bounded window: at most ``window`` outstanding requests, so a flood
   of responses can never queue unbounded verify/apply work;
+- short responses are only a Byzantine strike when they are a provable
+  lie: the server's own advert covers the range AND the response is
+  short of it with byte headroom below max_resp_bytes. Honest shortness
+  — the byte cap was hit, or the advert was lowered because rows are
+  missing — resumes the fetch from the end of what was served instead
+  of striking (a byte-capped server always serves >= max_resp_bytes,
+  see reactor._serve_range);
 - Byzantine servers (forged certificate, wrong epoch snapshot,
-  truncated range, tx bytes that don't hash to the certified tx_hash)
-  are detected, punished through PeerScoreBoard.punish (crossing the
-  score floor evicts), banned locally, and rotated away from — the
-  recovering node's state is never poisoned because nothing is applied
-  before verification;
+  mixed-height certificate, provably truncated range, tx bytes that
+  don't hash to the certified tx_hash) are detected, punished through
+  PeerScoreBoard.punish (crossing the score floor evicts), banned
+  locally — their adverts dropped so a banned liar's inflated
+  seq_count cannot pin lag() — and rotated away from; the recovering
+  node's state is never poisoned because nothing is applied before
+  verification;
 - when every candidate peer fails ``max_rounds`` consecutive rounds the
   client degrades to the consensus-block fallback state (the block
   reactor's catch-up replay remains the recovery path of last resort),
@@ -57,6 +74,7 @@ from ..types import TxVoteSet
 from ..types.tx_vote import sign_bytes_many
 from ..types.validator import ValidatorSet
 from ..utils.clock import monotonic
+from ..utils.failpoints import FailpointError
 from ..verifier import ScalarVoteVerifier
 from ..store.tx_store import _decode_votes
 from . import wire
@@ -115,6 +133,10 @@ class SyncManager:
         self._resp_q: _queue.Queue = _queue.Queue()
         self._req_id = 0
         self._verifiers: dict[tuple, ScalarVoteVerifier] = {}
+        # height -> ValidatorSet the client trusts for that height:
+        # state-store records plus sets learned through the trust-chain
+        # endorsement path (_verify_apply); sync-thread only
+        self._trusted_vals: dict[int, ValidatorSet] = {}
         self.state = STATE_IDLE
         self._consec_failed_rounds = 0
         self._backoff_level = 0
@@ -175,15 +197,27 @@ class SyncManager:
         best = self._best_advert()
         return max(0, best - local)
 
-    def _best_advert(self) -> int:
+    def _servable_adverts(self) -> dict[str, tuple[int, int]]:
+        """Adverts from peers the client would actually select: banned
+        (Byzantine-struck) peers are excluded, so one liar advertising an
+        inflated seq_count cannot pin lag() >= threshold after it is
+        banned and flip the node into a permanent syncing/fallback
+        cycle while the fast path is fine."""
+        now = monotonic()
         with self._mtx:
-            if not self._adverts:
-                return 0
-            return max(seq for seq, _h in self._adverts.values())
+            return {
+                n: a
+                for n, a in self._adverts.items()
+                if self._banned.get(n, 0.0) <= now
+            }
+
+    def _best_advert(self) -> int:
+        adverts = self._servable_adverts()
+        return max((seq for seq, _h in adverts.values()), default=0)
 
     def snapshot(self) -> dict:
+        adverts = self._servable_adverts()
         with self._mtx:
-            adverts = dict(self._adverts)
             banned = [n for n, t in self._banned.items() if t > monotonic()]
         return {
             "state": _STATE_NAMES.get(self.state, str(self.state)),
@@ -259,17 +293,12 @@ class SyncManager:
     def _select_peer(self):
         """Best candidate: highest advertised seq count among connected,
         non-banned peers; PeerScoreBoard score breaks ties."""
-        now = monotonic()
         scores = self.scoreboard.scores() if self.scoreboard is not None else {}
-        with self._mtx:
-            adverts = dict(self._adverts)
-            banned = {n for n, t in self._banned.items() if t > now}
+        adverts = self._servable_adverts()
         local = self.tx_store.seq_count()
         best, best_key = None, None
         for peer in self.switch.peers():
             nid = peer.node_id
-            if nid in banned:
-                continue
             adv = adverts.get(nid)
             if adv is None or adv[0] <= local:
                 continue
@@ -310,6 +339,10 @@ class SyncManager:
                 self.metrics.byzantine_strikes.add(1)
             with self._mtx:
                 self._banned[peer.node_id] = monotonic() + cfg.byzantine_ban
+                # a proven liar's advert is worthless — drop it so lag()
+                # reflects only peers we would actually fetch from (it
+                # re-adverts on the next status tick if still connected)
+                self._adverts.pop(peer.node_id, None)
             if self.scoreboard is not None:
                 self.scoreboard.punish(peer.node_id, cfg.byzantine_penalty)
         else:
@@ -331,7 +364,7 @@ class SyncManager:
         range order. Raises SyncError on stall or Byzantine evidence."""
         cfg = self.config
         pending: dict[int, tuple[int, int, float]] = {}  # req_id -> (start, count, sent)
-        ready: dict[int, tuple] = {}  # start -> (advert, entries, snapshots, t_sent)
+        ready: dict[int, tuple] = {}  # start -> (served, entries, snapshots, t_sent)
         next_start = cursor
         applied = 0
         # drain stale responses from prior rounds
@@ -342,7 +375,7 @@ class SyncManager:
                 break
         while (cursor < target or pending) and not self._stop.is_set():
             while len(pending) < cfg.window and next_start < target:
-                count = min(cfg.batch, target - next_start)
+                count = min(cfg.batch, cfg.max_range, target - next_start)
                 rid = self._next_req_id()
                 if not peer.try_send(
                     CHANNEL_SYNC, wire.encode_range_req(rid, next_start, count)
@@ -366,23 +399,53 @@ class SyncManager:
                     f"{peer.node_id} answered start {start} for {r_start}",
                     byzantine=True,
                 )
-            expected = min(r_count, max(advert, target) - r_start)
-            if len(entries) < expected:
+            served = len(entries)
+            served_bytes = sum(len(c) + len(t) for _h, c, t in entries)
+            # a short response is only a provable lie when the server's
+            # OWN advert covers the range AND it stopped with byte
+            # headroom: a byte-capped honest server always serves
+            # >= max_resp_bytes (reactor appends before checking the
+            # cap), and one with missing rows lowers its advert. A
+            # count-capped server (max_range below our batch) is honest
+            # too. Everything else resumes from the end of the prefix.
+            expected = min(r_count, max(0, advert - r_start))
+            if (
+                served < expected
+                and served_bytes < cfg.max_resp_bytes
+                and served < cfg.max_range
+            ):
                 raise SyncError(
                     f"truncated range from {peer.node_id}: "
-                    f"{len(entries)} entries, expected {expected}",
+                    f"{served} entries, expected {expected} "
+                    f"with byte headroom",
                     byzantine=True,
                 )
-            ready[r_start] = (r_count, entries, snapshots, t_sent)
+            if advert < target:
+                # the server can serve less than this round planned (rows
+                # lost, or it re-advertised higher than it can prove):
+                # shrink the walk honestly instead of demanding it
+                target = advert
+            rem_start = r_start + served
+            rem_count = min(r_count - served, target - rem_start)
+            if rem_count > 0:
+                # honest short response (byte/count cap): resume the tail
+                # of this range — progress, not a strike
+                rid = self._next_req_id()
+                if not peer.try_send(
+                    CHANNEL_SYNC, wire.encode_range_req(rid, rem_start, rem_count)
+                ):
+                    raise SyncError(f"send to {peer.node_id} failed")
+                pending[rid] = (rem_start, rem_count, monotonic())
+            ready[r_start] = (served, entries, snapshots, t_sent)
             # apply contiguously from the cursor (never out of order: the
             # commit-order log must extend in the server's order)
             while cursor in ready:
-                r_count, entries, snapshots, t_sent = ready.pop(cursor)
+                served, entries, snapshots, t_sent = ready.pop(cursor)
                 span_hash = self._first_sampled(entries)
                 if span_hash is not None:
                     self.tracer.span(span_hash, SPAN_SYNC_FETCH, t_sent, monotonic())
                 applied += self._verify_apply(peer, entries, snapshots)
-                cursor += r_count
+                cursor += served
         return applied
 
     def _wait_budget(self, pending: dict) -> float:
@@ -401,15 +464,74 @@ class SyncManager:
                 return tx_hash
         return None
 
-    def _own_vals_for(self, height: int) -> ValidatorSet:
-        vals = (
-            self.state_store.load_validators(height)
-            if self.state_store is not None
-            else None
-        )
-        if vals is None:
-            vals = self.txflow.val_set
-        return vals
+    def _vals_for(self, height: int) -> tuple[ValidatorSet, bool]:
+        """Validator set to verify ``height``'s votes under, and whether
+        it is a set of OUR OWN record (pinned/persisted) or merely the
+        current-set fallback. ``on_record=False`` tells _verify_apply it
+        may verify under a server-claimed snapshot instead, gated on the
+        trust-chain endorsement check."""
+        vals = self._trusted_vals.get(height)
+        if vals is not None:
+            return vals, True
+        if self.state_store is not None:
+            vals = self.state_store.load_validators(height)
+            if vals is not None:
+                self._trusted_vals[height] = vals
+                return vals, True
+        return self.txflow.val_set, False
+
+    def _anchor_for(self, height: int) -> ValidatorSet:
+        """The most recent set we trust at or below ``height`` — the
+        root the trust chain extends from when a server claims a set we
+        have no record for."""
+        best_h, best = -1, None
+        for h, vs in self._trusted_vals.items():
+            if best_h < h <= height:
+                best_h, best = h, vs
+        return best if best is not None else self.txflow.val_set
+
+    @staticmethod
+    def _endorsed(votes, anchor: ValidatorSet) -> bool:
+        """True when the certificate's (already signature-verified)
+        signers include members of ``anchor`` holding a 2/3 quorum of
+        ITS power: a quorum of the last set we trust signed under the
+        claimed set, endorsing that it was in force at that height —
+        honest validators only sign under the set they believe active
+        (light-client-style transition endorsement; an address pins its
+        pub_key, so a signature valid under the claimed set is a
+        signature by the anchor's validator of the same address)."""
+        power, seen = 0, set()
+        for v in votes:
+            addr = v.validator_address
+            if addr in seen:
+                continue
+            seen.add(addr)
+            _i, val = anchor.get_by_address(addr)
+            if val is not None:
+                power += val.voting_power
+        return power >= anchor.quorum_power()
+
+    def _learn_vals(self, height: int, vals: ValidatorSet) -> None:
+        """Pin (and persist) the set a verified certificate proved was
+        in force at ``height``, so later rounds — and restarts — resolve
+        it as a record of our own instead of re-running the endorsement
+        chain."""
+        if height in self._trusted_vals:
+            return
+        self._trusted_vals[height] = vals
+        if len(self._trusted_vals) > 64:
+            # keep the most recent heights: they are the anchors future
+            # transitions chain from (older ones reload from the store)
+            for h in sorted(self._trusted_vals)[: len(self._trusted_vals) - 64]:
+                del self._trusted_vals[h]
+        if (
+            self.state_store is not None
+            and self.state_store.load_validators(height) is None
+        ):
+            try:
+                self.state_store.save_validators(height, vals)
+            except (OSError, FailpointError):
+                pass  # durable pin is best-effort; the cache carries on
 
     def _verifier_for(self, vals: ValidatorSet) -> ScalarVoteVerifier:
         fp = _set_fingerprint(vals)
@@ -428,7 +550,10 @@ class SyncManager:
             return 0
         nid = peer.node_id
         t_verify0 = monotonic()
-        parsed = []  # (tx_hash, votes, tx, tx_key, vals) in response order
+        # (tx_hash, votes, tx, tx_key, vals, height, unchained) per entry,
+        # response order; unchained marks a server-claimed set we have no
+        # record for — verified below, then gated on endorsement
+        parsed = []
         for tx_hash, cert_blob, tx in entries:
             if self.tx_store.has_tx(tx_hash):
                 parsed.append(None)  # dedup: already committed locally
@@ -446,6 +571,7 @@ class SyncManager:
                 raise SyncError(f"{nid} served an undecodable certificate", byzantine=True)
             if not votes:
                 raise SyncError(f"{nid} served an empty certificate", byzantine=True)
+            height = votes[0].height
             for v in votes:
                 # sign bytes zero TxKey (types.tx_vote): the vote's own
                 # hash/key fields are forgeable without breaking the
@@ -456,22 +582,38 @@ class SyncManager:
                         "different tx",
                         byzantine=True,
                     )
-            height = votes[0].height
-            vals = self._own_vals_for(height)
+                if v.height != height:
+                    # mixed-height certificate: after a rotation,
+                    # genuinely-signed votes from another height's set
+                    # could tally under this height's stake weights and
+                    # fake a quorum no single height reached
+                    raise SyncError(
+                        f"{nid} served a certificate mixing vote heights",
+                        byzantine=True,
+                    )
+            vals, on_record = self._vals_for(height)
             claimed = snapshots.get(height)
+            unchained = False
             if claimed is not None and _set_fingerprint(claimed) != _set_fingerprint(
                 vals
             ):
-                # wrong epoch snapshot: the server claims these votes were
-                # cast under a different validator set than OUR record for
-                # that height — verification always uses our record, so
-                # the lie cannot poison state, but it is still proof of a
-                # bad server
-                raise SyncError(
-                    f"{nid} claims a different validator set at height {height}",
-                    byzantine=True,
-                )
-            parsed.append((tx_hash, votes, tx, tx_key, vals))
+                if on_record:
+                    # wrong epoch snapshot: the server claims these votes
+                    # were cast under a different validator set than OUR
+                    # record for that height — verification always uses
+                    # our record, so the lie cannot poison state, but it
+                    # is still proof of a bad server
+                    raise SyncError(
+                        f"{nid} claims a different validator set at height {height}",
+                        byzantine=True,
+                    )
+                # no record of our own for this height (wiped/fresh node
+                # recovering across an epoch boundary): verify under the
+                # server's snapshot; it is only ACCEPTED if the
+                # certificate's proven signers chain back to a quorum of
+                # the nearest set we DO trust (endorsement pass below)
+                vals, unchained = claimed, True
+            parsed.append((tx_hash, votes, tx, tx_key, vals, height, unchained))
         # batched verify, grouped by validator set (one group per epoch)
         groups: dict[tuple, list[int]] = {}
         for i, p in enumerate(parsed):
@@ -487,7 +629,7 @@ class SyncManager:
             val_idx: list[int] = []
             tx_slot: list[int] = []
             for slot, i in enumerate(idxs):
-                _h, votes, _tx, _k, _vals = parsed[i]
+                _h, votes, _tx, _k, _vals, _height, _u = parsed[i]
                 vb = sign_bytes_many(votes, self.chain_id)
                 for v, sb in zip(votes, vb):
                     vi = addr_to_idx.get(v.validator_address)
@@ -530,6 +672,28 @@ class SyncManager:
                     f"{nid} served a certificate below 2/3+ stake",
                     byzantine=True,
                 )
+        # trust-chain endorsement for sets we had no record for: the
+        # signatures are now known-good, so the signers' identities are
+        # proven — require that they carry a 2/3 quorum of the nearest
+        # set we DO trust before accepting the claimed set
+        for p in parsed:
+            if p is None or not p[6]:
+                continue
+            _h, votes, _tx, _k, _vals, height, _u = p
+            if not self._endorsed(votes, self._anchor_for(height)):
+                # NOT a Byzantine strike: our own record may simply be
+                # too stale to chain across the rotation — fail the
+                # round; the consensus-block fallback remains the path
+                # of last resort if no peer can chain us forward
+                raise SyncError(
+                    f"{nid} claims a validator set at height {height} "
+                    "that no quorum of our trusted set endorses"
+                )
+        # pin what this response proved: every height whose certificate
+        # verified resolves locally from now on (and across restarts)
+        for p in parsed:
+            if p is not None:
+                self._learn_vals(p[5], p[4])
         span_hash = self._first_sampled(entries)
         if span_hash is not None:
             self.tracer.span(span_hash, SPAN_SYNC_VERIFY, t_verify0, monotonic())
@@ -543,7 +707,7 @@ class SyncManager:
         for p in parsed:
             if p is None:
                 continue
-            tx_hash, votes, tx, tx_key, vals = p
+            tx_hash, votes, tx, tx_key, vals, _height, _u = p
             t0 = monotonic()
             vs = TxVoteSet(self.chain_id, votes[0].height, tx_hash, tx_key, vals)
             for v in votes:

@@ -117,10 +117,8 @@ class SyncReactor(Reactor):
                 # advert-vs-entries check is keyed on OUR advert below
                 advert = min(advert, _seq)
                 break
-            size += len(cert) + len(tx)
-            if entries and size > cfg.max_resp_bytes:
-                break
             entries.append((tx_hash, cert, tx))
+            size += len(cert) + len(tx)
             try:
                 h = _decode_votes(cert)[0].height
             except Exception:
@@ -135,6 +133,15 @@ class SyncReactor(Reactor):
                     vals = self.current_vals()
                 if vals is not None:
                     snapshots[h] = vals
+            if size >= cfg.max_resp_bytes:
+                # append-then-check: a byte-capped response always carries
+                # >= max_resp_bytes served bytes (overshoot is at most one
+                # entry; get_channels gives the frame 2x headroom), which
+                # is exactly what lets the client tell honest byte-cap
+                # truncation from a Byzantine short range. The snapshot
+                # collection above runs BEFORE this break so even the
+                # capping entry ships with its height's validator set.
+                break
         if self.tamper is not None:
             entries, snapshots = self.tamper(entries, snapshots)
         self.served_ranges += 1

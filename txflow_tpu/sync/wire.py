@@ -15,14 +15,18 @@ Three message kinds, each a 1-byte tag + uvarint/length-prefixed fields
   votes, byte-identical to what the server committed); each snapshot is
   ``height lp(vals_json)`` — the validator set the server had ON RECORD
   for that vote height (state store JSON codec). ``advert`` is the
-  server's seq_count at serve time, so a response that is short versus
-  the server's own advert is detectable as a truncated range.
+  server's seq_count at serve time (lowered to the first unservable row
+  when rows are missing), so a response that is short versus the
+  server's own advert WITH byte headroom below max_resp_bytes is
+  detectable as a provably truncated range; honest shortness (byte cap
+  hit, rows missing) resumes instead of striking.
 
 The client NEVER trusts the snapshot for verification when it has its
-own record for that height — the server copy exists so a wrong-epoch
-snapshot from a Byzantine server is detectable (mismatch = strike) and
-so a freshly-joined node (no local record) can cross-check it against
-quorum membership.
+own record for that height — a mismatch against a record is a
+Byzantine strike. A freshly-joined/wiped node with no record for a
+height verifies under the snapshot but accepts it only when the
+certificate's signature-proven signers carry a 2/3 quorum of the
+nearest validator set the client does trust (manager._endorsed).
 """
 
 from __future__ import annotations
