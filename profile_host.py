@@ -24,6 +24,17 @@ import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+# mesh profiling (BENCH_MESH_DEVICES>1): the CPU platform needs the
+# virtual-device flag in place before the txflow imports pull in jax
+_MESH = int(os.environ.get("BENCH_MESH_DEVICES", "0") or 0)
+if _MESH > 1 and "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={_MESH}"
+    ).strip()
+
 import numpy as np
 
 from txflow_tpu.node import LocalNet
@@ -76,6 +87,15 @@ def main() -> None:
     cfg.engine.batch_wait = float(os.environ.get("BENCH_BATCH_WAIT", "0.05"))
     cfg.engine.commit_interval = int(os.environ.get("BENCH_COMMIT_INTERVAL", "1"))
     cfg.engine.idle_flush = float(os.environ.get("BENCH_IDLE_FLUSH", cfg.engine.idle_flush))
+    # sharded host prep (--host-prep-workers / BENCH_HOST_PREP_WORKERS):
+    # each engine assembles sign bytes across a worker pool; the per-node
+    # critical-path lines below then split host time into prep_serial vs
+    # prep_pool_wait, which is where a >= 2x host-prep reduction shows up
+    workers = int(os.environ.get("BENCH_HOST_PREP_WORKERS", "0") or 0)
+    if "--host-prep-workers" in sys.argv:
+        workers = int(sys.argv[sys.argv.index("--host-prep-workers") + 1])
+    cfg.engine.host_prep_workers = workers
+    cfg.engine.mesh_devices = _MESH
 
     net = LocalNet(
         n_vals,
@@ -164,6 +184,12 @@ def main() -> None:
                 f" coalesce[full={co['full_batches']} "
                 f"linger={co['linger_flushes']} "
                 f"cold={co['cold_fallback_votes']}]"
+            )
+        if "prep_sign_s" in s:
+            line += (
+                f" hostprep[workers={s.get('host_prep_workers', 0)} "
+                f"sign={s['prep_sign_s']:.3f}s "
+                f"pool_wait={s['prep_pool_wait_s']:.3f}s]"
             )
         ad = s.get("adaptive_depth")
         if ad is not None:

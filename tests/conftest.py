@@ -18,6 +18,16 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Persistent XLA compile cache, shared with bench.py/__graft_entry__.py:
+# many test files independently jit the same bucket-shaped programs, and
+# each fresh function object misses the in-memory jit cache even when the
+# HLO is identical — the disk cache turns those (and every compile of a
+# rerun suite) into loads. On the 1-core CI box this is minutes of wall
+# time per tier-1 run.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+)
 # Runtime lock-order auditing is ON for the whole tier-1 suite (must be
 # set before any txflow_tpu module constructs a lock). Opt out of the
 # audit by exporting TXFLOW_LOCK_AUDIT=0 explicitly.

@@ -146,7 +146,9 @@ def test_engine_crash_then_restart_replays_exactly_once(tmp_path, point):
         node2.broadcast_tx(fresh)
         node2.tx_vote_pool.check_tx(sign_tx_vote(pv, fresh))
         assert wait_until(lambda: node2.is_committed(fresh))
-        assert app2.delivered[fresh] == 1
+        # store-then-apply: the TxStore row (is_committed) lands before the
+        # app delivery, so give the committer its window instead of racing it
+        assert wait_until(lambda: app2.delivered[fresh] == 1)
     finally:
         node2.stop()
 

@@ -17,6 +17,7 @@ import sys
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from txflow_tpu.ops import fe13
 
@@ -191,6 +192,7 @@ print("RADIX13 KERNEL PARITY OK")
     assert "RADIX13 KERNEL PARITY OK" in r.stdout
 
 
+@pytest.mark.slow  # 8-way mesh compile of the radix13 kernel: ~65s on 1-core CPU
 def test_sharded_mesh_parity_radix13():
     """The 8-device shard_map verify+tally path under TXFLOW_FE_RADIX=13:
     decisions must match the scalar golden model (the radix swap must

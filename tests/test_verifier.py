@@ -106,6 +106,7 @@ def test_prior_stake_latches_quorum(valset4):
     assert r2.maj23[0] and r2.stake[0] == 30
 
 
+@pytest.mark.slow  # 8-way mesh compile: ~80s on the 1-core CPU CI box
 def test_sharded_matches_single_device(valset4):
     vals, seeds = valset4
     mesh = make_mesh(8)
@@ -233,6 +234,7 @@ def test_verifier_mux_prior_stake_isolated():
         mux.stop()
 
 
+@pytest.mark.slow  # two 8-way mesh compiles: ~60s on the 1-core CPU CI box
 def test_ring_tally_matches_psum_step():
     """The explicit ppermute ring all-reduce must produce bit-identical
     tallies to the psum formulation over the virtual mesh."""

@@ -26,7 +26,11 @@ r5 sim result (4096 txs, serialized device):
                         value_no_shared_cache of 12.0k.
 
 Usage: JAX_PLATFORMS=cpu python tools/sim_device.py [--fixed-ms 8]
-       [--per-slot-us 27.6] [--txs 4096]
+       [--per-slot-us 27.6] [--txs 4096] [--mesh-devices 4] [--psum-ms 0.5]
+       [--host-workers 4] [--host-us-per-vote 41]
+With --mesh-devices N the per-slot bill divides across N chips (plus one
+psum per step); the run ends with a host-vs-device crossover table showing
+the mesh size past which HOST prep binds and worker scaling takes over.
 """
 
 import argparse
@@ -65,10 +69,16 @@ class SimDeviceVerifier(ScalarVoteVerifier):
     hardware holds the claims while the kernel runs."""
 
     def __init__(self, val_set, shared_cache=None, fixed_s=0.008,
-                 per_slot_s=27.6e-6, buckets=(4096, 16384)):
+                 per_slot_s=27.6e-6, buckets=(4096, 16384),
+                 mesh_devices=1, psum_s=0.0005):
         super().__init__(val_set, shared_cache=shared_cache)
         self._fixed_s = fixed_s
         self._per_slot_s = per_slot_s
+        # N-way vote-sharded mesh: per-slot work divides across devices,
+        # plus ONE stake psum per step (parallel.mesh ring/psum combine —
+        # a single small collective regardless of batch size)
+        self._mesh = max(1, int(mesh_devices))
+        self._psum_s = psum_s if self._mesh > 1 else 0.0
         self.buckets = buckets
         # the device's own miss ladder derivation (verifier.py
         # DeviceVoteVerifier.__init__) — bench pair (4096, 16384)
@@ -82,17 +92,23 @@ class SimDeviceVerifier(ScalarVoteVerifier):
         )
         self.device_calls = 0
         self.device_slots = 0
+        self.device_busy_s = 0.0
 
     def _charge(self, n: int, ladder) -> None:
         if n == 0:
             return
-        b = bucket_size(n, ladder)
-        # one physical chip: concurrent callers serialize; counters are
-        # shared across engine threads, so they mutate under the lock
+        # mesh shards pad to per-device divisibility, same as
+        # DeviceVoteVerifier (bucket_size multiple=_n_shards)
+        b = bucket_size(n, ladder, multiple=self._mesh)
+        cost = self._fixed_s + self._psum_s + b * self._per_slot_s / self._mesh
+        # one physical chip (or slice): concurrent callers serialize;
+        # counters are shared across engine threads, so they mutate
+        # under the lock
         with _DEVICE_LOCK:
             self.device_calls += 1
             self.device_slots += b
-            time.sleep(self._fixed_s + b * self._per_slot_s)
+            self.device_busy_s += cost
+            time.sleep(cost)
 
     def _validity(self, val_idx, keep) -> np.ndarray:
         n_vals = len(self._pub_keys)
@@ -149,7 +165,8 @@ class SimDeviceVerifier(ScalarVoteVerifier):
         return TallyResult(valid, stake, stake >= q, ~keep | pending)
 
 
-def run(shared: bool, n_txs: int, fixed_s: float, per_slot_s: float) -> dict:
+def run(shared: bool, n_txs: int, fixed_s: float, per_slot_s: float,
+        mesh_devices: int = 1, psum_s: float = 0.0005) -> dict:
     n_vals = 4
     pvs = [MockPV(hashlib.sha256(b"sim%d" % i).digest()) for i in range(n_vals)]
     by_addr = {pv.get_address(): pv for pv in pvs}
@@ -168,7 +185,8 @@ def run(shared: bool, n_txs: int, fixed_s: float, per_slot_s: float) -> dict:
 
     def mk():
         v = SimDeviceVerifier(
-            val_set, shared_cache=cache, fixed_s=fixed_s, per_slot_s=per_slot_s
+            val_set, shared_cache=cache, fixed_s=fixed_s, per_slot_s=per_slot_s,
+            mesh_devices=mesh_devices, psum_s=psum_s,
         )
         verifiers.append(v)
         return v
@@ -214,10 +232,34 @@ def run(shared: bool, n_txs: int, fixed_s: float, per_slot_s: float) -> dict:
         "wall_s": round(wall, 2),
         "device_calls": sum(v.device_calls for v in verifiers),
         "device_slots": sum(v.device_slots for v in verifiers),
-        "device_busy_s": round(sum(
-            v.device_calls * fixed_s + v.device_slots * per_slot_s
-            for v in verifiers), 2),
+        "device_busy_s": round(sum(v.device_busy_s for v in verifiers), 2),
     }
+
+
+def print_crossover(fixed_s, psum_s, per_slot_s, host_us_per_vote,
+                    host_workers, bucket=4096):
+    """Host-vs-device crossover: on an N-way mesh the device step is
+    fixed + psum + b*per_slot/N, but the HOST still preps every vote —
+    b*host_us/W with a W-worker prep pool. Past the crossover mesh size,
+    adding devices buys nothing; adding host workers does."""
+    w = max(1, host_workers)
+    host_s = bucket * host_us_per_vote / 1e6 / w
+    print(f"host-vs-device crossover at bucket {bucket}, "
+          f"{w} host worker(s) (host prep {host_s*1e3:.1f} ms/batch):")
+    crossed = None
+    for n in (1, 2, 4, 8, 16, 32, 64):
+        dev_s = fixed_s + (psum_s if n > 1 else 0.0) + bucket * per_slot_s / n
+        step_s = max(dev_s, host_s)
+        bound = "host" if host_s > dev_s else "device"
+        if crossed is None and host_s > dev_s:
+            crossed = n
+        print(f"  mesh={n:2d}  device {dev_s*1e3:7.1f} ms  "
+              f"ceiling {bucket/step_s:9.0f} votes/s  bound={bound}")
+    if crossed is None:
+        print("  device-bound through mesh=64: more devices still pay off")
+    else:
+        print(f"  crossover at mesh={crossed}: host-bound beyond this — "
+              f"scale host workers (--host-workers), not devices")
 
 
 def main():
@@ -225,11 +267,24 @@ def main():
     ap.add_argument("--fixed-ms", type=float, default=8.0)
     ap.add_argument("--per-slot-us", type=float, default=27.6)
     ap.add_argument("--txs", type=int, default=4096)
+    ap.add_argument("--mesh-devices", type=int, default=1,
+                    help="model an N-way vote-sharded mesh (one psum/step)")
+    ap.add_argument("--psum-ms", type=float, default=0.5,
+                    help="per-step stake-psum cost when mesh > 1")
+    ap.add_argument("--host-workers", type=int, default=1,
+                    help="host-prep pool width for the crossover model")
+    ap.add_argument("--host-us-per-vote", type=float, default=41.0,
+                    help="host prep cost per vote (sign-bytes + compact prep; "
+                         "~41 us/vote gives the ROADMAP's 18.4k host-bound)")
     args = ap.parse_args()
     for shared in (True, False):
-        r = run(shared, args.txs, args.fixed_ms / 1e3, args.per_slot_us / 1e6)
+        r = run(shared, args.txs, args.fixed_ms / 1e3, args.per_slot_us / 1e6,
+                args.mesh_devices, args.psum_ms / 1e3)
         label = "shared-cache+claims" if shared else "no-cache (honest baseline)"
         print(f"{label:28s} {r}")
+    print_crossover(args.fixed_ms / 1e3, args.psum_ms / 1e3,
+                    args.per_slot_us / 1e6, args.host_us_per_vote,
+                    args.host_workers)
 
 
 if __name__ == "__main__":

@@ -443,6 +443,11 @@ _HOT_NOBLOCK_FUNCS = {
         "_sample_commit_rate", "_effective_bulk_rate", "_peer_rate_exceeded",
         "_priority_sender_exceeded", "_storage_degraded",
     },
+    # host-prep pool enqueue: called from inside the engine's batch-prep
+    # window on every drain. One job alloc + one lock-free SimpleQueue
+    # put — if submit ever grows a lock or a bounded wait, the pool
+    # serializes the very path it exists to parallelize.
+    "txflow_tpu/engine/hostprep.py": {"submit"},
 }
 
 
@@ -499,6 +504,7 @@ class HotPathPass(LintPass):
 # untraced ABCI accounting.
 _TRACE_SCOPE = (
     "txflow_tpu/engine/txflow.py",
+    "txflow_tpu/engine/hostprep.py",
     "txflow_tpu/trace/",
     "txflow_tpu/admission/controller.py",
     "txflow_tpu/pool/",

@@ -1,0 +1,196 @@
+"""Sharded host-prep pool: worker threads that parallelize batch prep.
+
+The device-economics sim (tools/sim_device.py) and the r05 artifacts show
+the shared-cache configuration is host-bound: the serial Python prep —
+sign-bytes assembly, signature splitting, nibble/window-table extraction —
+caps throughput below the device-step rate. The two heavy prep stages both
+release the GIL (the native _prep.so work runs inside ctypes; the numpy
+fallback spends its time in vectorized C loops), so sharding a batch's
+rows across a handful of threads is real parallelism even on GIL builds.
+
+Design constraints, in order:
+
+- **The submit side must stay off the lock radar.** ``submit`` is
+  hotpath-pinned by txlint (analysis/passes.py): one allocation plus one
+  ``queue.SimpleQueue.put`` — a reentrant C-level enqueue that never
+  blocks and takes no Python-visible lock. The engine thread can enqueue
+  shards mid-step without adding a lock edge to the audited graph.
+- **The caller is a worker.** ``map_shards`` splits ``[0, n)`` into
+  ``workers`` contiguous shards, enqueues all but the last, and runs the
+  last inline on the calling thread — a pool of W workers uses W-1
+  threads, and ``workers=1`` degenerates to the serial path with zero
+  queue traffic. While waiting for its own shards the caller steals
+  queued jobs (other engines' shards included), so a shared pool never
+  idles a caller behind a busy worker.
+- **Shards are contiguous and ordered.** Each prep stage writes rows
+  ``[lo, hi)`` of preallocated output arrays, so the assembled batch is
+  byte-identical to the serial prep regardless of completion order
+  (parity pinned by tests/test_mesh_engine.py).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+
+from ..analysis.lockgraph import make_lock
+from ..utils.clock import monotonic
+
+
+class _Job:
+    """One enqueued shard: ``fn(lo, hi)`` plus its completion latch."""
+
+    __slots__ = ("fn", "lo", "hi", "done", "result", "error")
+
+    def __init__(self, fn, lo: int, hi: int):
+        self.fn = fn
+        self.lo = lo
+        self.hi = hi
+        self.done = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+
+    def run(self) -> None:
+        try:
+            self.result = self.fn(self.lo, self.hi)
+        except BaseException as exc:  # re-raised on the caller in map_shards
+            self.error = exc
+        finally:
+            self.done.set()
+
+
+class HostPrepPool:
+    """Fixed-size thread pool specialized for contiguous-shard batch prep.
+
+    ``workers`` counts the calling thread: a pool of 4 spawns 3 daemon
+    threads and runs the caller's shard inline. Shared freely between
+    engines (the bench shares one pool across all four nodes via the
+    shared DeviceVoteVerifier); per-call wait accounting is returned to
+    each caller rather than accumulated globally.
+    """
+
+    def __init__(self, workers: int, name: str = "hostprep"):
+        self.workers = max(1, int(workers))
+        self._q: _queue.SimpleQueue = _queue.SimpleQueue()
+        self._closed = False
+        self._stats_mtx = make_lock("engine.HostPrepPool._stats_mtx")
+        self.jobs_total = 0
+        self.steals_total = 0
+        self.pool_wait_s = 0.0
+        self._threads: list[threading.Thread] = []
+        for i in range(self.workers - 1):
+            t = threading.Thread(
+                target=self._worker, name=f"{name}-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    # -- submit side (hotpath-pinned: O(1), no locks) -------------------
+    def submit(self, fn, lo: int, hi: int) -> _Job:
+        """Enqueue ``fn(lo, hi)``; returns the job handle.
+
+        One object allocation + one SimpleQueue.put (lock-free C
+        enqueue). Never blocks; safe to call from inside the engine's
+        step loop.
+        """
+        job = _Job(fn, lo, hi)
+        self._q.put(job)
+        return job
+
+    # -- worker side ----------------------------------------------------
+    def _worker(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            job.run()
+
+    def _steal_one(self) -> bool:
+        """Run one queued job on the calling thread, if any is waiting."""
+        try:
+            job = self._q.get_nowait()
+        except _queue.Empty:
+            return False
+        if job is None:
+            # keep the shutdown sentinel flowing to a real worker
+            self._q.put(None)
+            return False
+        job.run()
+        return True
+
+    # -- caller side ----------------------------------------------------
+    def shard_bounds(self, n: int) -> list[tuple[int, int]]:
+        """Contiguous ``[lo, hi)`` spans covering ``[0, n)``, one per worker.
+
+        Early shards get the remainder, so spans differ in length by at
+        most one row; empty spans are dropped (n < workers).
+        """
+        w = min(self.workers, max(1, n))
+        base, extra = divmod(n, w)
+        bounds = []
+        lo = 0
+        for i in range(w):
+            hi = lo + base + (1 if i < extra else 0)
+            if hi > lo:
+                bounds.append((lo, hi))
+            lo = hi
+        return bounds
+
+    def map_shards(self, n: int, fn) -> tuple[list, float]:
+        """Run ``fn(lo, hi)`` over contiguous shards of ``[0, n)``.
+
+        Returns ``(results, pool_wait_s)``: per-shard results in shard
+        order, and the wall time this caller spent blocked on shards it
+        did not execute itself (the "host-bound on the queue" half of
+        the profile_host.py critical-path split). The last shard always
+        runs inline on the caller; while any submitted shard is still
+        pending the caller drains the queue, so a congested shared pool
+        costs queueing delay, never deadlock.
+        """
+        bounds = self.shard_bounds(n)
+        if len(bounds) <= 1 or self._closed:
+            lo, hi = bounds[0] if bounds else (0, 0)
+            return [fn(lo, hi)], 0.0
+        jobs = [self.submit(fn, lo, hi) for lo, hi in bounds[:-1]]
+        lo, hi = bounds[-1]
+        inline = _Job(fn, lo, hi)
+        inline.run()
+        wait_s = 0.0
+        for job in jobs:
+            if job.done.is_set():
+                continue
+            # steal queued work (ours or another caller's) before parking
+            while not job.done.is_set() and self._steal_one():
+                self.steals_total += 1
+            if not job.done.is_set():
+                t0 = monotonic()
+                job.done.wait()
+                wait_s += monotonic() - t0
+        results = []
+        for job in jobs + [inline]:
+            if job.error is not None:
+                raise job.error
+            results.append(job.result)
+        with self._stats_mtx:
+            self.jobs_total += len(bounds)
+            self.pool_wait_s += wait_s
+        return results, wait_s
+
+    def stats(self) -> dict:
+        with self._stats_mtx:
+            return {
+                "workers": self.workers,
+                "jobs_total": self.jobs_total,
+                "steals_total": self.steals_total,
+                "pool_wait_s": self.pool_wait_s,
+            }
+
+    def close(self, timeout: float = 1.0) -> None:
+        """Stop the worker threads (idempotent; pending jobs still run)."""
+        if self._closed:
+            return
+        self._closed = True
+        for _ in self._threads:
+            self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=timeout)

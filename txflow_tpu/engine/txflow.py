@@ -57,6 +57,12 @@ from ..verifier import DeviceVoteVerifier, ReadyTicket, ScalarVoteVerifier
 from .execution import TxExecutor
 
 
+# below this many drained votes the host-pool shard bookkeeping costs
+# more than the parallel assembly saves (mirrors ops.ed25519_batch's
+# _POOL_MIN_ROWS; light-load steps stay serial either way)
+_POOL_MIN_VOTES = 256
+
+
 class _StepPrep:
     """Host-side product of one pool drain: everything the verify call
     and the routing pass need. In the pipelined loop this is built while
@@ -119,11 +125,20 @@ class _BatchCoalescer:
     )
 
     def __init__(self, buckets, cap: int, min_batch: int, linger: float,
-                 metrics=None, clock=monotonic, tracer=None):
-        targets = sorted(b for b in buckets if min_batch <= b <= cap)
+                 metrics=None, clock=monotonic, tracer=None,
+                 multiple: int = 1):
+        # mesh divisibility: a sharded verifier pads every dispatch up to
+        # a multiple of its shard count anyway (verifier.bucket_size), so
+        # round the full-bucket targets here and drain exactly what the
+        # compiled sharded shape holds — zero pad waste on full buckets,
+        # same ladder length
+        m = max(1, int(multiple))
+        targets = sorted(
+            {-(-b // m) * m for b in buckets if min_batch <= b <= cap}
+        )
         # no bucket fits the [min_batch, cap] band: degrade to cap-sized
         # dispatches (still one stable shape — cap is the largest bucket)
-        self.targets = targets or [cap]
+        self.targets = targets or [-(-cap // m) * m]
         self.linger = linger
         self.full_batches = 0
         self.linger_flushes = 0
@@ -221,11 +236,34 @@ class TxFlow:
             try:
                 from ..verifier import ResilientVoteVerifier
 
+                # mesh-sharded verify (EngineConfig.mesh_devices): shard
+                # the vote axis across the first N devices of the default
+                # backend; anything short of a usable multi-device mesh
+                # (fewer devices than asked, no backend) degrades to the
+                # single-device path — decisions are identical either way
+                mesh = None
+                if int(self.config.mesh_devices or 0) > 1:
+                    try:
+                        from ..parallel.mesh import make_mesh
+
+                        mesh = make_mesh(int(self.config.mesh_devices))
+                        if mesh.size <= 1:
+                            mesh = None
+                    except Exception:
+                        mesh = None
                 # resilient by default: a device fault mid-run degrades to
                 # the scalar golden model (retry/backoff/re-probe policy,
                 # verifier.ResilientVoteVerifier) instead of erroring the
                 # vote path; decisions are bit-identical either way
-                self.verifier = ResilientVoteVerifier(DeviceVoteVerifier(val_set))
+                self.verifier = ResilientVoteVerifier(
+                    DeviceVoteVerifier(
+                        val_set,
+                        mesh=mesh,
+                        host_prep_workers=int(
+                            self.config.host_prep_workers or 0
+                        ),
+                    )
+                )
             except ValueError:  # total power >= 2^30: int32 tally overflow
                 self.verifier = ScalarVoteVerifier(val_set)
         else:
@@ -293,6 +331,16 @@ class TxFlow:
         self._pipe_active_s = 0.0
         self._pipe_last_collect = 0.0
         self._pipe_lock_wait_s = 0.0
+        # host-prep split (profile_host.py prep_serial vs prep_pool_wait):
+        # sign_s is the assembly stage's wall time, pool_wait_s the slice
+        # of it this thread spent parked behind pool shards it didn't run
+        self._pipe_prep_sign_s = 0.0
+        self._pipe_prep_pool_wait_s = 0.0
+        # sharded host-prep pool (engine.hostprep), wired in start():
+        # device verifiers share ONE pool across co-located engines via
+        # ensure_host_pool; scalar verifiers get an engine-owned pool
+        self._host_pool = None
+        self._own_host_pool = False
         # durable-path degradation (ENOSPC/EIO/failpoint on TxStore
         # writes): the commit stays applied in memory and the node keeps
         # serving, but it flags itself degraded — surfaced via /health
@@ -379,7 +427,28 @@ class TxFlow:
                     linger=self.config.coalesce_linger,
                     metrics=self.metrics,
                     tracer=self.tracer,
+                    # full-bucket drains land exactly on the sharded
+                    # verifier's rounded shapes (verifier.bucket_size)
+                    multiple=self._verifier_shards(),
                 )
+        if int(self.config.host_prep_workers or 0) > 1 and self._host_pool is None:
+            from .shapes import _unwrap_device
+
+            dev = _unwrap_device(self.verifier)
+            if dev is not None:
+                # shared verifier => shared pool: N co-located engines
+                # must not spawn N * workers threads (ensure_host_pool
+                # is first-sizer-wins)
+                self._host_pool = dev.ensure_host_pool(
+                    int(self.config.host_prep_workers)
+                )
+            else:
+                from .hostprep import HostPrepPool
+
+                self._host_pool = HostPrepPool(
+                    int(self.config.host_prep_workers), name="hostprep-engine"
+                )
+                self._own_host_pool = True
         if self.config.adaptive_depth and self._depth_ctrl is None:
             from .adaptive import AdaptiveDepthController
 
@@ -409,6 +478,15 @@ class TxFlow:
 
         dev = _unwrap_device(self.verifier)
         return dev.buckets if dev is not None else None
+
+    def _verifier_shards(self) -> int:
+        """Mesh shard count of the (possibly wrapped) device verifier;
+        1 for scalar/single-device."""
+        from .shapes import _unwrap_device
+
+        dev = _unwrap_device(self.verifier)
+        shards = getattr(dev, "_n_shards", 1) if dev is not None else 1
+        return max(1, int(shards))
 
     def _setup_background_warmup(self) -> None:
         """Wire the cold-shape gate: a shared ShapeWarmRegistry as the
@@ -451,6 +529,12 @@ class TxFlow:
             self._commit_q.put(None)  # drain sentinel
             self._committer.join(timeout=10)
             self._committer = None
+        if self._own_host_pool and self._host_pool is not None:
+            # engine-owned pool only: a verifier-attached pool is shared
+            # with other engines and outlives this one
+            self._host_pool.close()
+            self._host_pool = None
+            self._own_host_pool = False
         # flush queued commit events so indexer/subscribers see every
         # committed tx before shutdown returns
         self.tx_executor.drain_events()
@@ -808,15 +892,47 @@ class TxFlow:
                 # gate in tests/test_trace.py pins this whole path
                 prep.trace_txs = [h for h in slot_of if tr.sampled(h)][:8]
 
-            from ..types.tx_vote import sign_bytes_many
+            # snapshot the set-epoch references this drain belongs to:
+            # update_state replaces both wholesale under _mtx, so the
+            # assembly below reads a consistent pair outside the lock
+            addr_to_idx = self._addr_to_idx
+            prep.verifier = self.verifier
+        # sign-bytes / signature / validator-index assembly: pure
+        # per-vote work over the drained (engine-local) batch, moved OUT
+        # from under _mtx — consensus-path claims and gossip ingest no
+        # longer queue behind the heaviest slice of host prep — and
+        # sharded across the host pool when one is attached (contiguous
+        # slices in vote order, so the assembled batch is byte-identical
+        # to the serial path; parity pinned by tests/test_mesh_engine.py)
+        from ..types.tx_vote import sign_bytes_many
 
+        pool = self._host_pool
+        t_sign = monotonic()
+        if pool is not None and pool.workers > 1 and len(votes) >= _POOL_MIN_VOTES:
+
+            def _assemble(lo: int, hi: int):
+                vs = votes[lo:hi]
+                return (
+                    sign_bytes_many(vs, self.chain_id),
+                    [v.signature or b"" for v in vs],
+                    [addr_to_idx.get(v.validator_address, -1) for v in vs],
+                )
+
+            parts, wait_s = pool.map_shards(len(votes), _assemble)
+            prep.msgs = [m for p in parts for m in p[0]]
+            prep.sigs = [s for p in parts for s in p[1]]
+            prep.val_idx = np.array(
+                [i for p in parts for i in p[2]], dtype=np.int64
+            )
+            self._pipe_prep_pool_wait_s += wait_s
+        else:
             prep.msgs = sign_bytes_many(votes, self.chain_id)
             prep.sigs = [v.signature or b"" for v in votes]
             prep.val_idx = np.array(
-                [self._addr_to_idx.get(v.validator_address, -1) for v in votes],
+                [addr_to_idx.get(v.validator_address, -1) for v in votes],
                 dtype=np.int64,
             )
-            prep.verifier = self.verifier
+        self._pipe_prep_sign_s += monotonic() - t_sign
         end = monotonic()
         dur = end - t0
         self._pipe_prep_s += dur
@@ -1020,6 +1136,15 @@ class TxFlow:
             "dispatch_wait_s": round(self._pipe_wait_s, 4),
             "route_s": round(self._pipe_route_s, 4),
             "lock_wait_s": round(self._pipe_lock_wait_s, 4),
+            # host-prep split: sign/assembly stage wall time, and the
+            # slice of it spent parked on host-pool shards (report.py
+            # prep_serial vs prep_pool_wait)
+            "prep_sign_s": round(self._pipe_prep_sign_s, 4),
+            "prep_pool_wait_s": round(self._pipe_prep_pool_wait_s, 4),
+            "host_prep_workers": (
+                self._host_pool.workers if self._host_pool is not None else 0
+            ),
+            "mesh_devices": self._verifier_shards(),
         }
         co = self._coalescer
         stats["coalesce"] = {

@@ -270,7 +270,10 @@ class Node:
         if verifier is None and nc.use_device_verifier and mesh is not None:
             from ..verifier import DeviceVoteVerifier, ResilientVoteVerifier
 
-            verifier = DeviceVoteVerifier(val_set, mesh=mesh)
+            verifier = DeviceVoteVerifier(
+                val_set, mesh=mesh,
+                host_prep_workers=int(engine_cfg.host_prep_workers or 0),
+            )
             if nc.resilient_verifier:
                 verifier = ResilientVoteVerifier(verifier)
         self.txflow = TxFlow(

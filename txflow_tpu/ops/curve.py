@@ -31,6 +31,17 @@ TABLE_SIZE = 1 << TABLE_WINDOW  # 16
 NWINDOWS = 64  # 256 bits / 4
 
 
+def _pvary(x, axis_name):
+    """``lax.pvary`` where this JAX has it, identity where it doesn't.
+
+    The varying-manual-axes cast only exists on JAX builds with the
+    shard_map VMA checker; pre-VMA builds (<= 0.4.x) have no variance
+    types on the loop carry — there is nothing to cast and no checker to
+    satisfy, so the sharded wrappers trace fine without it."""
+    fn = getattr(jax.lax, "pvary", None)
+    return x if fn is None else fn(x, axis_name)
+
+
 def ext_identity(batch_shape):
     z = jnp.zeros((*batch_shape, fe.NLIMB), dtype=jnp.int32)
     one = z.at[..., 0].set(1)
@@ -176,7 +187,7 @@ def double_scalar_mul_indexed(
 
     init = ext_identity(s_nibbles.shape[:-1])
     if axis_name is not None:
-        init = tuple(jax.lax.pvary(t, axis_name) for t in init)
+        init = tuple(_pvary(t, axis_name) for t in init)
     return jax.lax.fori_loop(0, NWINDOWS, step, init)
 
 
@@ -212,10 +223,10 @@ def double_scalar_mul(s_nibbles, h_nibbles, base_table, a_tables, axis_name=None
 
     init = ext_identity(s_nibbles.shape[:-1])
     if axis_name is not None:
-        # required (no hasattr fallback): the sharded wrappers run with the
-        # VMA checker ON, which needs this variance cast — a JAX without
-        # lax.pvary could not trace them anyway
-        init = tuple(jax.lax.pvary(t, axis_name) for t in init)
+        # the sharded wrappers run with the VMA checker ON, which needs
+        # this variance cast (see _pvary: identity on pre-VMA JAX, where
+        # shard_map has no variance types and nothing to cast)
+        init = tuple(_pvary(t, axis_name) for t in init)
     return jax.lax.fori_loop(0, NWINDOWS, step, init)
 
 

@@ -183,6 +183,10 @@ class CompactBatch:
     r_y: np.ndarray  # [B, 32] uint8 low 255 bits of sig[:32]
     r_sign: np.ndarray  # [B] uint8 bit 255 of sig[:32]
     pre_ok: np.ndarray  # [B] bool host pre-checks passed
+    # seconds the preparing thread spent parked behind host-pool shards
+    # it didn't run itself (0.0 on the serial path) — prep accounting
+    # only, never part of the batch's identity
+    pool_wait_s: float = 0.0
 
     @property
     def size(self) -> int:
@@ -198,20 +202,58 @@ def nibbles_from_le_bytes(b: np.ndarray) -> np.ndarray:
     return out
 
 
+# below this many rows a pooled prep loses to its own shard bookkeeping
+# (job objects + events cost ~10 us/shard; a 256-row native prep is ~50 us)
+_POOL_MIN_ROWS = 256
+
+
 def prepare_compact(
     msgs: list[bytes],
     sigs: list[bytes],
     val_idx: np.ndarray,
     epoch: EpochTables,
+    pool=None,
 ) -> CompactBatch:
     """Host prep: native C batch (SHA-512 + mod L + ScMinimal) when the
-    compiler-built module is available, else the pure-Python loop below —
-    the parity oracle (tests/test_native_prep.py pins them identical)."""
+    compiler-built module is available, else the vectorized numpy path
+    (``_prepare_compact_np``); ``_prepare_compact_py`` is the per-row
+    parity oracle for both (tests/test_native_prep.py, test_mesh_engine).
+
+    ``pool`` (engine.hostprep.HostPrepPool): shard the rows contiguously
+    across workers — every row is prepared independently, so the
+    concatenated shards are byte-identical to the serial prep. The native
+    prep releases the GIL inside ctypes, so sharding is real parallelism;
+    the caller reads the queue-wait share back off
+    ``CompactBatch.pool_wait_s``."""
     from .. import native
 
-    if len(msgs) and native.available():
-        return _prepare_compact_native(msgs, sigs, val_idx, epoch)
-    return _prepare_compact_py(msgs, sigs, val_idx, epoch)
+    fn = (
+        _prepare_compact_native
+        if len(msgs) and native.available()
+        else _prepare_compact_np
+    )
+    n = len(msgs)
+    if pool is None or pool.workers <= 1 or n < _POOL_MIN_ROWS:
+        return fn(msgs, sigs, val_idx, epoch)
+    vi = np.asarray(val_idx)
+
+    def _shard(lo: int, hi: int) -> CompactBatch:
+        return fn(msgs[lo:hi], sigs[lo:hi], vi[lo:hi], epoch)
+
+    parts, wait_s = pool.map_shards(n, _shard)
+    if len(parts) == 1:
+        parts[0].pool_wait_s = wait_s
+        return parts[0]
+    out = CompactBatch(
+        np.concatenate([p.s_nibbles for p in parts]),
+        np.concatenate([p.h_nibbles for p in parts]),
+        np.concatenate([p.val_idx for p in parts]),
+        np.concatenate([p.r_y for p in parts]),
+        np.concatenate([p.r_sign for p in parts]),
+        np.concatenate([p.pre_ok for p in parts]),
+        pool_wait_s=wait_s,
+    )
+    return out
 
 
 def _prepare_compact_native(
@@ -299,6 +341,71 @@ def _prepare_compact_py(
         r_y,
         r_sign,
         pre_ok,
+    )
+
+
+def _prepare_compact_np(
+    msgs: list[bytes],
+    sigs: list[bytes],
+    val_idx: np.ndarray,
+    epoch: EpochTables,
+) -> CompactBatch:
+    """Vectorized numpy prep — the serving path when native/_prep.so is
+    unavailable (no C compiler in the container).
+
+    Bit-identical to ``_prepare_compact_py`` (pinned by
+    tests/test_mesh_engine.py): signature splitting, the ScMinimal
+    big-endian compare, and R extraction are all array ops; only the
+    SHA-512 + mod-L reduction stays per row (hashlib has no batch API),
+    and only over rows that survive the vectorized pre-checks. The
+    per-row Python loop this replaces spent most of its time on row
+    slicing and per-row frombuffer, not on the hash."""
+    n = len(msgs)
+    n_vals = len(epoch.pub_keys)
+    vi = np.asarray(val_idx, dtype=np.int64)
+    clipped = np.clip(vi, 0, max(n_vals - 1, 0))
+    idx_ok = (vi >= 0) & (vi < n_vals)
+    sig_ok = np.fromiter((len(s) == 64 for s in sigs), bool, n)
+    sig_cat = (
+        b"".join(sigs)
+        if bool(sig_ok.all())
+        else b"".join(s if len(s) == 64 else _ZERO64 for s in sigs)
+    )
+    sig_all = np.frombuffer(sig_cat, np.uint8).reshape(n, 64)
+    ok = idx_ok & sig_ok & (epoch.key_ok[clipped] if n_vals else False)
+    # ScMinimal (S < L), vectorized: compare big-endian byte rows
+    # lexicographically — sign of the first differing byte decides
+    s_be = sig_all[:, :31:-1]  # bytes 63..32: S, most-significant first
+    l_be = np.frombuffer(host_ed.L.to_bytes(32, "big"), np.uint8)
+    diff = s_be.astype(np.int16) - l_be.astype(np.int16)
+    nz = diff != 0
+    first = np.where(nz.any(axis=1), nz.argmax(axis=1), 31)
+    ok &= np.take_along_axis(diff, first[:, None], 1)[:, 0] < 0
+    s_le = np.where(ok[:, None], sig_all[:, 32:], 0).astype(np.uint8)
+    h_le = np.zeros((n, 32), np.uint8)
+    sha512 = hashlib.sha512
+    L = host_ed.L
+    for i in np.flatnonzero(ok):
+        sig = sigs[i]
+        h = (
+            int.from_bytes(
+                sha512(sig[:32] + epoch.pub_keys[vi[i]] + msgs[i]).digest(),
+                "little",
+            )
+            % L
+        )
+        h_le[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
+    # failed rows stay all-zero, matching the per-row oracle
+    r_y = np.where(ok[:, None], sig_all[:, :32], 0).astype(np.uint8)
+    r_sign = (r_y[:, 31] >> 7).astype(np.uint8)
+    r_y[:, 31] &= 0x7F
+    return CompactBatch(
+        nibbles_from_le_bytes(s_le),
+        nibbles_from_le_bytes(h_le),
+        clipped.astype(np.int32),
+        r_y,
+        r_sign,
+        ok,
     )
 
 

@@ -20,6 +20,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from . import curve
+
 
 def tally_kernel(valid, tx_slot, power, n_slots: int):
     """Per-slot stake sums for one device shard.
@@ -128,9 +130,10 @@ def compact_step_packed(axis_name: str | None = None):
         if axis_name is not None:
             # stake/maj are psum-replicated (device-invariant); concatenating
             # them with the device-varying valid segment needs an explicit
-            # variance cast for the VMA checker
-            total = jax.lax.pvary(total, axis_name)
-            maj = jax.lax.pvary(maj, axis_name)
+            # variance cast for the VMA checker (identity on pre-VMA JAX,
+            # see curve._pvary)
+            total = curve._pvary(total, axis_name)
+            maj = curve._pvary(maj, axis_name)
         return jnp.concatenate([valid.astype(jnp.int32), total, maj])
 
     return f

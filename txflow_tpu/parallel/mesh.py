@@ -30,6 +30,21 @@ from ..ops import ed25519_batch, tally
 VOTE_AXIS = "votes"
 
 
+def _axis_size(axis_name: str) -> int:
+    """Static (Python-int) mesh-axis size inside a shard_map'd function.
+
+    ``jax.lax.axis_size`` only exists on newer jax; 0.4.x exposes the
+    bound frame through ``jax.core.axis_frame`` (which returns the size
+    directly on 0.4.37, a frame object with ``.size`` on other builds)."""
+    size = getattr(jax.lax, "axis_size", None)
+    if size is not None:
+        return int(size(axis_name))
+    from jax import core
+
+    frame = core.axis_frame(axis_name)
+    return int(frame if isinstance(frame, int) else frame.size)
+
+
 def make_mesh(n_devices: int | None = None, axis_name: str = VOTE_AXIS) -> Mesh:
     """1-D mesh over the first n_devices (default: all) local devices."""
     devs = jax.devices()
@@ -123,7 +138,7 @@ def ring_tally(stake_partial, axis_name: str = VOTE_AXIS):
     real ICI (XLA schedules each hop independently) and as the pattern
     template for future ring-style kernels.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def hop(_, carry):

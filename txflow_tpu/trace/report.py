@@ -29,14 +29,23 @@ def critical_path(pipeline_stats: dict, trace_digest: dict | None = None) -> dic
     lock_wait = stats.get("lock_wait_s", 0.0)
     prep = stats.get("prep_s", 0.0)
     route = stats.get("route_s", 0.0)
+    host = max(0.0, prep - lock_wait) + route
     parts = {
-        "host_s": max(0.0, prep - lock_wait) + route,
+        "host_s": host,
         "device_s": stats.get("dispatch_wait_s", 0.0),
         "lock_wait_s": lock_wait,
         "linger_s": sum_s("linger"),
     }
     busy = sum(parts.values())
     out = {k: round(v, 4) for k, v in parts.items()}
+    # When a host-prep pool ran, split the host bucket into the serial
+    # remainder vs time spent waiting on pool shards (pool wait is wall
+    # time the caller could NOT overlap — the lever sharded host prep
+    # pulls on). host_s stays their sum for downstream compat.
+    pool_wait = stats.get("prep_pool_wait_s", 0.0)
+    if pool_wait > 0.0:
+        out["prep_pool_wait_s"] = round(min(pool_wait, host), 4)
+        out["prep_serial_s"] = round(host - min(pool_wait, host), 4)
     if busy > 0:
         out["fractions"] = {
             k.removesuffix("_s"): round(v / busy, 4) for k, v in parts.items()
@@ -59,6 +68,9 @@ def merge_critical_paths(per_node: list[dict]) -> dict:
     the fleet-level line bench.py emits."""
     keys = ("host_s", "device_s", "lock_wait_s", "linger_s")
     total = {k: round(sum(cp.get(k, 0.0) for cp in per_node), 4) for k in keys}
+    for k in ("prep_serial_s", "prep_pool_wait_s"):
+        if any(k in cp for cp in per_node):
+            total[k] = round(sum(cp.get(k, 0.0) for cp in per_node), 4)
     busy = sum(total.values())
     if busy > 0:
         total["fractions"] = {
@@ -85,6 +97,11 @@ def format_line(cp: dict) -> str:
         for k in ("host_s", "device_s", "lock_wait_s", "linger_s")
     )
     line = f"critical-path: {parts} bound={cp.get('bound', 'n/a')}"
+    if "prep_pool_wait_s" in cp:
+        line += (
+            f" host[prep_serial={cp.get('prep_serial_s', 0.0):.3f}s"
+            f" prep_pool_wait={cp['prep_pool_wait_s']:.3f}s]"
+        )
     if cp.get("network_residual_ms") is not None:
         line += f" net_residual={cp['network_residual_ms']:.1f}ms"
     return line

@@ -166,6 +166,19 @@ class EngineConfig:
     # kvstore/counter apps and of the handshake replay path, which replays
     # per tx). 1 = reference-faithful.
     commit_interval: int = 1
+    # mesh-sharded verify (parallel/mesh.py): shard each padded device
+    # batch data-parallel across this many devices of the default
+    # backend (one psum tally per step). 0 or 1 = single-device verify.
+    # Bucket widths are rounded up to mesh divisibility by the verifier
+    # and the coalescer, so the warm bucket ladder is unchanged in
+    # count — only in width — and epoch restages stay zero-recompile.
+    mesh_devices: int = 0
+    # sharded host-prep pool (engine/hostprep.py): worker threads that
+    # parallelize sign-bytes assembly and nibble/window prep. The native
+    # prep (_prep.so) releases the GIL inside ctypes, so sharding rows
+    # across workers is real parallelism even on GIL builds. 0 = serial
+    # prep on the engine thread (reference behavior).
+    host_prep_workers: int = 0
 
 
 @dataclass

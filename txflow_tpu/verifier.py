@@ -32,6 +32,7 @@ from .analysis.lockgraph import make_lock, note_blocking
 from .crypto import ed25519 as host_ed
 from .ops import ed25519_batch, tally
 from .types.validator import ValidatorSet
+from .utils.clock import monotonic
 
 # Batch-size buckets: in-flight vote counts vary wildly (SURVEY.md §7 hard
 # part 4); padding to the next bucket keeps the number of distinct compiled
@@ -40,15 +41,19 @@ DEFAULT_BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
 
 
 def bucket_size(n: int, buckets=DEFAULT_BUCKETS, multiple: int = 1) -> int:
-    """Smallest bucket >= n, rounded up to `multiple` for mesh divisibility.
+    """Smallest bucket >= n after rounding buckets up to `multiple`.
 
-    The bucket is chosen first and then rounded, so a non-power-of-two mesh
-    (e.g. 6 devices) still yields one stable shape per bucket instead of a
-    fresh shape per batch size.
+    Each ladder rung is rounded up for mesh divisibility BEFORE the
+    comparison, so a non-power-of-two mesh (e.g. 6 devices) still yields
+    one stable shape per bucket instead of a fresh shape per batch size —
+    and a drain sized exactly at a rounded rung (the coalescer's
+    shard-rounded full-bucket targets) pads zero instead of spilling to
+    the next rung up.
     """
     for b in buckets:
-        if b >= n:
-            return ((b + multiple - 1) // multiple) * multiple
+        bb = ((b + multiple - 1) // multiple) * multiple
+        if bb >= n:
+            return bb
     # beyond the largest bucket: round up to a multiple
     return ((n + multiple - 1) // multiple) * multiple
 
@@ -603,6 +608,7 @@ class DeviceVoteVerifier:
         mesh=None,
         buckets=DEFAULT_BUCKETS,
         shared_cache: "VerifyCache | bool | None" = None,
+        host_prep_workers: int = 0,
     ):
         # cross-engine verify-result sharing (VerifyCache docstring):
         # True = own cache; an instance = share with other verifiers
@@ -648,13 +654,42 @@ class DeviceVoteVerifier:
         _native.available()
 
         if mesh is not None:
-            from .parallel.mesh import sharded_compact_step_packed_cached
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from .parallel.mesh import (
+                VOTE_AXIS,
+                sharded_compact_step_packed_cached,
+            )
 
             self._n_shards = mesh.size
             self._fn = sharded_compact_step_packed_cached(mesh)
+            # per-batch staging shardings: padded vote-axis arrays are
+            # device_put split across the mesh, the prior-stake vector
+            # replicated — explicit placement so dispatch never falls
+            # back to an implicit host->device-0 transfer + reshard, and
+            # the compiled programs see one canonical input layout per
+            # bucket (zero-recompile across epoch restages, same as the
+            # single-device ladder)
+            self._vote_sharding = NamedSharding(self.mesh, PartitionSpec(VOTE_AXIS))
+            self._rep_sharding = NamedSharding(self.mesh, PartitionSpec())
         else:
             self._n_shards = 1
             self._fn = tally.compact_step_packed_jit()
+            self._vote_sharding = None
+            self._rep_sharding = None
+        # sharded host-prep pool (engine.hostprep): sized by the FIRST
+        # sizer — co-located engines sharing this verifier share one pool
+        # (ensure_host_pool), so worker count doesn't multiply per node
+        self._host_pool = None
+        self.host_prep_workers = 0
+        self._stats_mtx = make_lock("verifier.DeviceVoteVerifier._stats_mtx")
+        # host-prep stage seconds (prep_stats()): wall time inside
+        # prepare_compact on the dispatch paths, and the slice of it spent
+        # waiting on pool shards this thread didn't run itself
+        self._compact_s = 0.0
+        self._compact_pool_wait_s = 0.0
+        if host_prep_workers:
+            self.ensure_host_pool(host_prep_workers)
         # validator capacity: the power-of-two sizes the existing 4/16/64
         # test and bench configs already compile for are their own pow2,
         # so padding is free there and gives odd-sized sets in-place
@@ -688,6 +723,49 @@ class DeviceVoteVerifier:
     @property
     def _powers_dev(self):
         return self._stage.powers_dev
+
+    def ensure_host_pool(self, workers: int):
+        """Attach (or return) the shared host-prep pool, idempotently.
+
+        First caller with workers > 1 sizes it; later callers — the other
+        engines sharing this verifier — reuse it regardless of the count
+        they ask for, so a 4-node LocalNet over one shared verifier runs
+        ONE pool, not four. Returns the pool (None when serial)."""
+        if workers and workers > 1 and self._host_pool is None:
+            with self._stats_mtx:
+                if self._host_pool is None:
+                    from .engine.hostprep import HostPrepPool
+
+                    pool = HostPrepPool(workers, name="hostprep-verify")
+                    self.host_prep_workers = pool.workers
+                    self._host_pool = pool
+        return self._host_pool
+
+    def _prepare(self, msgs, sigs, val_idx, epoch) -> "ed25519_batch.CompactBatch":
+        """prepare_compact through the host pool (when attached), with
+        stage-seconds accounting for prep_stats()."""
+        t0 = monotonic()
+        batch = ed25519_batch.prepare_compact(
+            msgs, sigs, val_idx, epoch, pool=self._host_pool
+        )
+        dt = monotonic() - t0
+        with self._stats_mtx:
+            self._compact_s += dt
+            self._compact_pool_wait_s += batch.pool_wait_s
+        return batch
+
+    def prep_stats(self) -> dict:
+        """Host-prep stage seconds across every engine sharing this
+        verifier (bench result JSON + profile_host.py host-pool lines)."""
+        with self._stats_mtx:
+            out = {
+                "compact_s": self._compact_s,
+                "compact_pool_wait_s": self._compact_pool_wait_s,
+                "host_prep_workers": self.host_prep_workers,
+            }
+        if self._host_pool is not None:
+            out["pool"] = self._host_pool.stats()
+        return out
 
     def _build_stage(self, val_set: ValidatorSet) -> _DeviceStage:
         # int32 device tally: with dedup, per-slot batch stake and prior
@@ -854,7 +932,7 @@ class DeviceVoteVerifier:
         # whole kernel; padding slots receive no votes and slice away
         b_slots = bucket_size(n_slots, self.buckets)
 
-        batch = ed25519_batch.prepare_compact(msgs, sigs, val_idx, st.epoch)
+        batch = self._prepare(msgs, sigs, val_idx, st.epoch)
         batch.pre_ok &= keep
         # pad to bucket: pre_ok False + slot -1 => contributes nothing
         pad = b - n
@@ -873,6 +951,18 @@ class DeviceVoteVerifier:
         q = np.int32(st.val_set.quorum_power() if quorum is None else quorum)
 
         self.shapes_used.add(("fused", b, b_slots))
+        if self.mesh is not None:
+            # explicit placement: vote-axis arrays split across the mesh
+            # (b is a multiple of _n_shards by construction), prior
+            # replicated — the numpy buffers hand off without an extra
+            # host copy and the program never implicitly reshards
+            import jax
+
+            s_nib, h_nib, vidx, r_y, r_sign, pre_ok, slot = jax.device_put(
+                (s_nib, h_nib, vidx, r_y, r_sign, pre_ok, slot),
+                self._vote_sharding,
+            )
+            prior = jax.device_put(prior, self._rep_sharding)
         packed = self._fn(
             s_nib, h_nib, vidx, r_y, r_sign, pre_ok, slot,
             st.tables_dev, st.powers_dev, prior, q,
@@ -1026,12 +1116,12 @@ class DeviceVoteVerifier:
         # compiled programs use it, and the tally half of the program is
         # insensitive to slot width next to the verify half
         b_slots = self.buckets[0]
-        batch = ed25519_batch.prepare_compact(msgs, sigs, val_idx, st.epoch)
+        batch = self._prepare(msgs, sigs, val_idx, st.epoch)
         pad = b - n
         self.shapes_used.add(("verify", b, b_slots))
         if claim_keys and self.cache is not None:
             self.cache.heartbeat_many(claim_keys)
-        packed = self._fn(
+        vote_args = (
             _pad(batch.s_nibbles, pad),
             _pad(batch.h_nibbles, pad),
             _pad(batch.val_idx, pad),
@@ -1039,9 +1129,18 @@ class DeviceVoteVerifier:
             _pad(batch.r_sign, pad),
             _pad(batch.pre_ok, pad),
             np.full(b, -1, np.int32),
+        )
+        prior = np.zeros(b_slots, np.int32)
+        if self.mesh is not None:
+            import jax
+
+            vote_args = jax.device_put(vote_args, self._vote_sharding)
+            prior = jax.device_put(prior, self._rep_sharding)
+        packed = self._fn(
+            *vote_args,
             st.tables_dev,
             st.powers_dev,
-            np.zeros(b_slots, np.int32),
+            prior,
             np.int32(1),
         )
         if claim_keys and self.cache is not None:
