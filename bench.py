@@ -233,6 +233,12 @@ def run_latency_slo(platform: str) -> dict:
     n_txs = int(os.environ.get("BENCH_SLO_TXS", "256"))
     prio_frac = float(os.environ.get("BENCH_SLO_PRIORITY_FRAC", "0.25"))
     pace_tps = float(os.environ.get("BENCH_SLO_PACE_TPS", "200"))
+    # --net-profile <name> (BENCH_NET_PROFILE): run the SLO under WAN
+    # weather (netem/) — every link shaped + the adaptive peer transport
+    # on; the result stamps the profile and per-peer RTT/loss so two runs
+    # under different weather are comparable at a glance
+    net_profile = _cli_or_env("--net-profile", "BENCH_NET_PROFILE", "") or None
+    net_seed = int(_cli_or_env("--net-seed", "BENCH_NET_SEED", "11") or 11)
     cfg = test_config()
     cfg.mempool.size = max(cfg.mempool.size, 8 * n_txs)
     cfg.mempool.cache_size = max(cfg.mempool.cache_size, 2 * cfg.mempool.size)
@@ -243,6 +249,8 @@ def run_latency_slo(platform: str) -> dict:
         config=cfg,
         use_device_verifier=False,
         index_txs=False,
+        netem=net_profile,
+        netem_seed=net_seed,
     )
 
     # deterministic lane mix: every ceil(1/frac)-th tx carries a
@@ -297,9 +305,34 @@ def run_latency_slo(platform: str) -> dict:
         for n in net.nodes
     ]
     trace_digest = net.nodes[0].tracer.digest()
+    network = None
+    if net_profile is not None:
+        # per-link weather observations (RTT/loss from the in-band pings,
+        # shaper counters) — captured BEFORE stop so estimators are live
+        peers = {}
+        shaper_snap = None
+        for node in net.nodes:
+            snap = node.switch.net_snapshot()
+            shaper_snap = snap.get("shaper") or shaper_snap
+            for pid, ps in (snap.get("peers") or {}).items():
+                peers[f"{node.node_id}->{pid}"] = {
+                    "rtt_ms": ps.get("rtt_ms"),
+                    "loss": ps.get("loss"),
+                    "quarantined": ps.get("quarantined"),
+                }
+        # ONE shaper serves the whole LocalNet: any node's view is the
+        # aggregate
+        network = {
+            "profile": net_profile,
+            "seed": net_seed,
+            "peers": peers,
+            "shaper": shaper_snap,
+        }
     net.stop()
     return {
         "metric": "latency_slo",
+        "net_profile": net_profile,
+        "network": network,
         "lanes": {k: lane_quantiles(v) for k, v in lat.items()},
         "critical_path": merge_critical_paths(per_node),
         "critical_path_per_node": per_node,

@@ -448,6 +448,11 @@ _HOT_NOBLOCK_FUNCS = {
     # put — if submit ever grows a lock or a bounded wait, the pool
     # serializes the very path it exists to parallelize.
     "txflow_tpu/engine/hostprep.py": {"submit"},
+    # the shaper's send sits INSIDE every switch send-loop iteration: it
+    # must only draw from the seeded rng, push onto the delivery heap and
+    # return — the wire wait lives in the shaper's own deliver thread.
+    # A blocking call here turns weather latency into sender stall.
+    "txflow_tpu/netem/shaper.py": {"send", "try_send"},
 }
 
 
@@ -510,6 +515,10 @@ _TRACE_SCOPE = (
     "txflow_tpu/pool/",
     "txflow_tpu/reactors/",
     "txflow_tpu/sync/",
+    # weather timestamps (due times, flap schedule) must share the traced
+    # timeline: a pinned-clock test that shapes links would otherwise see
+    # deliveries scheduled on a clock the spans don't use
+    "txflow_tpu/netem/",
 )
 
 # the forbidden time.* names: every raw timestamp source. time.sleep is

@@ -42,6 +42,18 @@ class HealthConfig:
     recv_reward: float = 0.5  # per tick with inbound progress
     score_max: float = 4.0  # reward ceiling
     score_floor: float = -8.0  # at/below: evict (if a reconnector is wired)
+    # per tick while the adaptive transport (p2p/adaptive.py) holds the
+    # peer in slow-peer quarantine: bad weather that PERSISTS walks the
+    # peer to the score floor and through the same eviction + jittered-
+    # backoff reconnect as any other misbehavior — no second eviction path
+    quarantine_penalty: float = 0.5
+
+    # also re-dial peers that vanished WITHOUT a score eviction (reactor
+    # error on a corrupted frame, transport teardown): same jittered
+    # backoff path. Off by default — TCP assemblies already heal through
+    # the PEX ensure-loop, and drills that stop peers on purpose expect
+    # them to stay down; netem rigs (in-proc pipes have no PEX) turn it on
+    redial_lost_peers: bool = False
 
     # -- reconnect backoff (jittered, capped exponential) --
     reconnect_base: float = 0.25

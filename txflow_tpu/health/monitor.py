@@ -52,6 +52,10 @@ class HealthMonitor:
         """Wire the re-dial hook; eviction stays disabled without one."""
         self.scoreboard.reconnector = fn
 
+    def set_expected_peers(self, node_ids) -> None:
+        """Full-mesh roster for redial_lost_peers (see PeerScoreBoard)."""
+        self.scoreboard.set_expected(node_ids)
+
     # -- lifecycle --
 
     def start(self) -> None:

@@ -76,7 +76,16 @@ class PeerScoreBoard:
         self._tracks: dict[str, _PeerTrack] = {}
         self._backoff_level: dict[str, int] = {}
         self._pending: dict[str, float] = {}  # node_id -> reconnect due time
+        self._expected: set[str] = set()
         self._rng = random.Random(cfg.seed)
+
+    def set_expected(self, node_ids) -> None:
+        """Roster of peers this node should always hold a live link to.
+        Needed by ``redial_lost_peers``: a link torn down before any tick
+        observed it (e.g. a weather-corrupted frame during startup gossip)
+        leaves no track behind, so track cleanup alone can never re-dial
+        it — the roster is the ground truth the tick compares against."""
+        self._expected = {nid for nid in node_ids if nid != self.switch.node_id}
 
     # -- scoring --
 
@@ -123,6 +132,12 @@ class PeerScoreBoard:
                 and tr.sends_since_progress >= cfg.min_sends_for_stale
             ):
                 delta -= cfg.stale_penalty
+            # slow-peer quarantine (p2p/adaptive.py): sustained bad link
+            # weather bleeds score until the floor evicts through the
+            # normal reconnect/backoff machinery
+            net = getattr(peer, "net", None)
+            if net is not None and net.quarantined:
+                delta -= cfg.quarantine_penalty
             tr.score = min(cfg.score_max, tr.score + delta)
             if tr.score <= cfg.score_floor and self.reconnector is not None:
                 self._evict(peer, now)
@@ -132,7 +147,28 @@ class PeerScoreBoard:
         for nid in list(self._tracks):
             if nid not in live_ids:
                 del self._tracks[nid]
+                # a peer lost to a reactor/transport error (e.g. a link-
+                # corrupted frame failing decode) never went through
+                # _evict: without PEX (in-proc pipes) nobody would ever
+                # re-dial it — opt in to healing through the same
+                # jittered-backoff path
+                if cfg.redial_lost_peers:
+                    self._schedule_redial(nid, now)
+        # roster check: an expected peer with no live link, no track and
+        # no pending redial died before a tick ever saw it — a track-
+        # cleanup heuristic alone can never heal that
+        if cfg.redial_lost_peers:
+            for nid in self._expected:
+                if nid not in live_ids:
+                    self._schedule_redial(nid, now)
         self._drain_reconnects(now)
+
+    def _schedule_redial(self, nid: str, now: float) -> None:
+        if self.reconnector is None or nid in self._pending:
+            return
+        level = self._backoff_level.get(nid, 0)
+        self._backoff_level[nid] = level + 1
+        self._pending[nid] = now + self._backoff_delay(level)
 
     # -- external penalties (sync Byzantine scoring) --
 

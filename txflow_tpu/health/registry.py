@@ -16,12 +16,13 @@ import threading
 
 from ..analysis.lockgraph import make_lock
 
-from ..utils.metrics import HealthMetrics, Registry
+from ..utils.metrics import HealthMetrics, NetMetrics, Registry
 
 
 class DegradedModeRegistry:
     def __init__(self, metrics_registry: Registry):
         self.metrics = HealthMetrics(metrics_registry)
+        self.net_metrics = NetMetrics(metrics_registry)
         self._mtx = make_lock("health.DegradedModeRegistry._mtx")
         # event totals (watchdog + peer scorer hooks)
         self.watchdog_firings = 0
@@ -38,6 +39,7 @@ class DegradedModeRegistry:
         self._epoch: dict = {}
         self._sync: dict = {}
         self._storage: dict = {}
+        self._network: dict = {}
         self._watchdog_state: dict = {"inflight": 0, "oldest_stall_age": 0.0}
         self._healthy = True
 
@@ -110,6 +112,14 @@ class DegradedModeRegistry:
             m.verifier_device_healthy.set(1.0 if vstate["device_healthy"] else 0.0)
         n_peers = node.switch.n_peers()
         self.metrics.n_peers.set(n_peers)
+        # network weather (p2p/adaptive.py + netem/): per-peer RTT/loss/
+        # backlog, quarantine state, and shaper counters — republished as
+        # txflow_net_* gauges and the /health "network" section
+        network: dict = {}
+        net_snapshot = getattr(node.switch, "net_snapshot", None)
+        if net_snapshot is not None:
+            network = net_snapshot()
+            self.net_metrics.refresh_from(network)
         progress = {
             "fast_path_height": node.committed_height_view,
             "consensus_height": (
@@ -188,6 +198,7 @@ class DegradedModeRegistry:
             self._epoch = epoch_state
             self._sync = sync_state
             self._storage = storage_state
+            self._network = network
             self._healthy = healthy
         self.metrics.healthy.set(1.0 if healthy else 0.0)
 
@@ -223,4 +234,5 @@ class DegradedModeRegistry:
                 "epoch": dict(self._epoch),
                 "sync": dict(self._sync),
                 "storage": dict(self._storage),
+                "network": dict(self._network),
             }

@@ -1,0 +1,23 @@
+"""Network weather: deterministic WAN link conditioning on real sockets.
+
+``LinkShaper`` wraps the transport layer (below the per-peer priority
+queues, above TCP/SecretConnection/in-memory pipes) with seed-driven
+latency+jitter, token-bucket byte pacing, loss, duplication, corruption,
+reordering, and scheduled flap windows — composable with the message-level
+``faults.ChaosRouter`` and selectable as named profiles declared as data
+(``netem.PROFILES``: lan / intercontinental / lossy-edge / congested /
+flapping). The adaptive half lives in ``p2p.adaptive`` (per-peer RTT/loss
+estimators, bounded send queues, slow-peer quarantine); the proof lives in
+``tools/soak.py --wan-matrix``.
+"""
+
+from .profiles import PROFILES, NetProfile, get_profile
+from .shaper import LinkShaper, ShapedConnection
+
+__all__ = [
+    "PROFILES",
+    "NetProfile",
+    "get_profile",
+    "LinkShaper",
+    "ShapedConnection",
+]

@@ -1,0 +1,127 @@
+"""Named network-weather profiles, declared as data.
+
+Each profile models one directed link's weather: one-way propagation
+latency + uniform jitter, a serialization-rate cap (token-bucket byte
+pacing with a bounded backlog queue), and per-frame loss / duplication /
+corruption / reordering probabilities. ``flap_*`` describes deterministic
+up/down windows (no randomness — like a partition, flapping is a schedule,
+not a coin flip).
+
+The ``p50_budget_ms``/``p99_budget_ms`` fields are the per-scenario commit
+budgets the soak matrix (tools/soak.py --wan-matrix) gates on. They are
+deliberately loose regression nets for a 1-core CI box, not SLOs — scale
+them with ``SOAK_WAN_BUDGET_SCALE`` or floor p50 with ``SOAK_P50_BUDGET_MS``
+(documented in README "Network weather").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+
+@dataclass(frozen=True)
+class NetProfile:
+    name: str
+    latency_ms: float = 0.0  # one-way propagation delay
+    jitter_ms: float = 0.0  # uniform extra delay in [0, jitter_ms)
+    bandwidth_mbps: float = 0.0  # serialization rate cap; 0 = unlimited
+    queue_kib: int = 0  # pacing backlog cap (tail-drop); 0 = unlimited
+    loss: float = 0.0  # P(frame silently lost)
+    duplicate: float = 0.0  # P(frame delivered twice)
+    corrupt: float = 0.0  # P(one payload byte flipped)
+    reorder: float = 0.0  # P(frame held back an extra reorder_extra_ms)
+    reorder_extra_ms: float = 0.0
+    flap_period_s: float = 0.0  # 0 = link never flaps
+    flap_down_frac: float = 0.0  # fraction of each period spent down
+    p50_budget_ms: float = 4000.0
+    p99_budget_ms: float = 10000.0
+
+    @property
+    def bytes_per_s(self) -> float:
+        return self.bandwidth_mbps * 1e6 / 8.0
+
+    def scaled_budgets(self, scale: float) -> "NetProfile":
+        return replace(
+            self,
+            p50_budget_ms=self.p50_budget_ms * scale,
+            p99_budget_ms=self.p99_budget_ms * scale,
+        )
+
+
+PROFILES: dict[str, NetProfile] = {
+    p.name: p
+    for p in (
+        # co-located racks: the control — budgets here anchor the matrix
+        NetProfile(
+            "lan",
+            latency_ms=0.2,
+            jitter_ms=0.1,
+            p50_budget_ms=3000.0,
+            p99_budget_ms=8000.0,
+        ),
+        # geo-distributed committee (arxiv 2302.00418 runs WAN evaluations
+        # for exactly this shape): ~90ms one-way, mild jitter, rare loss
+        NetProfile(
+            "intercontinental",
+            latency_ms=90.0,
+            jitter_ms=10.0,
+            bandwidth_mbps=50.0,
+            queue_kib=2048,
+            loss=0.001,
+            p50_budget_ms=5000.0,
+            p99_budget_ms=12000.0,
+        ),
+        # last-mile/wireless edge: loss, reordering, and the occasional
+        # flipped byte (which verify-before-apply must catch, never commit)
+        NetProfile(
+            "lossy-edge",
+            latency_ms=30.0,
+            jitter_ms=15.0,
+            bandwidth_mbps=10.0,
+            queue_kib=512,
+            loss=0.05,
+            duplicate=0.01,
+            corrupt=0.003,
+            reorder=0.05,
+            reorder_extra_ms=40.0,
+            p50_budget_ms=7000.0,
+            p99_budget_ms=16000.0,
+        ),
+        # oversubscribed uplink: tight rate cap + shallow queue, so pacing
+        # and tail-drop (not the random-loss coin) dominate
+        NetProfile(
+            "congested",
+            latency_ms=20.0,
+            jitter_ms=5.0,
+            bandwidth_mbps=2.0,
+            queue_kib=64,
+            loss=0.01,
+            p50_budget_ms=7000.0,
+            p99_budget_ms=16000.0,
+        ),
+        # link that dies and returns on a schedule: exercises the jittered-
+        # backoff reconnector + address-book re-dial without dial storms
+        NetProfile(
+            "flapping",
+            latency_ms=10.0,
+            jitter_ms=3.0,
+            flap_period_s=4.0,
+            flap_down_frac=0.3,
+            p50_budget_ms=9000.0,
+            p99_budget_ms=20000.0,
+        ),
+    )
+}
+
+
+def get_profile(name_or_profile) -> NetProfile:
+    """Resolve a profile by name (or pass a NetProfile through)."""
+    if isinstance(name_or_profile, NetProfile):
+        return name_or_profile
+    try:
+        return PROFILES[name_or_profile]
+    except KeyError:
+        raise KeyError(
+            f"unknown net profile {name_or_profile!r}; "
+            f"known: {sorted(PROFILES)}"
+        ) from None

@@ -1,0 +1,282 @@
+"""LinkShaper: deterministic WAN weather on the transport send path.
+
+Sits BELOW the per-peer priority queues and ABOVE the raw connection:
+``Switch.add_peer_conn`` wraps each accepted/dialed connection (TCP,
+SecretConnection, or in-memory pipe) in a ``ShapedConnection``, so every
+frame a send loop hands to the transport passes through one directed
+link's weather — latency + jitter, token-bucket byte pacing with a
+bounded backlog (tail-drop), probabilistic loss / duplication /
+corruption, and deterministic flap windows. Each endpoint shapes its own
+outbound direction, so a duplex link is two independent directed streams.
+
+Determinism contract (mirrors faults/plan.py): every directed link owns a
+PRNG seeded from ``sha256(b"netem|<seed>|<src>|<dst>")``, drawn once per
+frame in send order, and the stream SURVIVES reconnects (the rng lives on
+the LinkShaper, not the connection). The domain prefix is disjoint from
+FaultPlan's ``b"faultplan|..."`` so composing a shaper with a ChaosRouter
+never perturbs existing seeded chaos behavior (tests/test_netem.py
+stream-stability test).
+
+Two deliberate asymmetries with ChaosRouter:
+
+- loss here returns True from ``send`` (the frame vanishes on the wire;
+  a TCP sender can't see an IP drop either) — returning False would make
+  the switch stop the peer;
+- flapping consumes NO randomness: down-windows are a schedule computed
+  from the link clock, like partitions in ChaosRouter.partition().
+
+Corruption flips one payload byte AFTER any chaos interception and (on
+keyed TCP) BEFORE SecretConnection encryption, so the flipped byte
+arrives authenticated-but-wrong — exactly the case verify-before-apply
+must catch and never commit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import itertools
+import random
+import threading
+import weakref
+
+from ..analysis.lockgraph import make_lock
+from ..utils import clock
+from .profiles import NetProfile, get_profile
+
+_STAT_KEYS = (
+    "frames",
+    "delivered",
+    "dropped",
+    "flap_dropped",
+    "queue_dropped",
+    "duplicated",
+    "corrupted",
+    "reordered",
+    "send_fail",
+)
+
+
+class ShapedConnection:
+    """One directed link's weather applied to a wrapped connection.
+
+    ``send``/``try_send`` are O(1): draw the link decision, push onto a
+    due-time heap, notify the delivery worker. The worker thread delivers
+    frames to the inner connection in due order (jitter larger than the
+    inter-frame gap therefore reorders, on top of the explicit reorder
+    hold-back). ``recv``/``close`` delegate to the inner connection.
+    """
+
+    def __init__(self, inner, shaper: "LinkShaper", src: str, dst: str):
+        self.inner = inner
+        self.label = getattr(inner, "label", "")
+        self._shaper = shaper
+        self._src = src
+        self._dst = dst
+        self._rng = shaper._link_rng(src, dst)
+        self.stats = {k: 0 for k in _STAT_KEYS}
+        self._heap: list = []  # (due, seq, chan_id, msg)
+        self._seq = itertools.count()
+        self._epoch = clock.monotonic()  # flap-schedule origin
+        self._next_free = 0.0  # token-bucket virtual clock
+        self._closed = False
+        self._mtx = make_lock(f"netem.ShapedConnection[{src}->{dst}]")
+        self._cond = threading.Condition(self._mtx)
+        self._worker = threading.Thread(
+            target=self._deliver_loop, name=f"netem-{src}->{dst}", daemon=True
+        )
+        self._worker.start()
+
+    # -- send path (called from the peer send loop) --
+
+    def send(self, chan_id: int, msg: bytes, timeout: float | None = 10.0) -> bool:
+        prof = self._shaper.profile_for(self._src, self._dst)
+        with self._cond:
+            if self._closed:
+                return False
+            st = self.stats
+            st["frames"] += 1
+            now = clock.monotonic()
+            # flap: scheduled down-windows, no randomness consumed
+            if prof.flap_period_s > 0.0:
+                phase = ((now - self._epoch) % prof.flap_period_s) / prof.flap_period_s
+                if phase < prof.flap_down_frac:
+                    st["flap_dropped"] += 1
+                    return True
+            rng = self._rng
+            u_loss = rng.random()
+            u_dup = rng.random()
+            u_corrupt = rng.random()
+            u_reorder = rng.random()
+            u_jitter = rng.random()
+            if u_loss < prof.loss:
+                st["dropped"] += 1
+                return True
+            delay = prof.latency_ms / 1e3 + u_jitter * (prof.jitter_ms / 1e3)
+            rate = prof.bytes_per_s
+            if rate > 0.0:
+                if self._next_free < now:
+                    self._next_free = now
+                backlog = (self._next_free - now) * rate
+                if prof.queue_kib > 0 and backlog > prof.queue_kib * 1024:
+                    st["queue_dropped"] += 1
+                    return True  # tail-drop: pacing queue is full
+                send_at = self._next_free
+                self._next_free = send_at + len(msg) / rate
+                delay += send_at - now
+            if u_reorder < prof.reorder:
+                st["reordered"] += 1
+                delay += prof.reorder_extra_ms / 1e3
+            if u_corrupt < prof.corrupt and len(msg) > 0:
+                st["corrupted"] += 1
+                pos = rng.randrange(len(msg))
+                corrupted = bytearray(msg)
+                corrupted[pos] ^= 0xFF
+                msg = bytes(corrupted)
+            due = now + delay
+            heapq.heappush(self._heap, (due, next(self._seq), chan_id, msg))
+            if u_dup < prof.duplicate:
+                st["duplicated"] += 1
+                heapq.heappush(
+                    self._heap, (due + 1e-3, next(self._seq), chan_id, msg)
+                )
+            self._cond.notify()
+        return True
+
+    def try_send(self, chan_id: int, msg: bytes) -> bool:
+        return self.send(chan_id, msg)
+
+    # -- delivery worker --
+
+    def _deliver_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._closed and (
+                    not self._heap or self._heap[0][0] > clock.monotonic()
+                ):
+                    if self._heap:
+                        wait = self._heap[0][0] - clock.monotonic()
+                        self._cond.wait(min(max(wait, 0.0), 0.2))
+                    else:
+                        self._cond.wait(0.2)
+                if self._closed:
+                    return
+                _, _, chan_id, msg = heapq.heappop(self._heap)
+            # inner.send outside the lock: a stalled socket must not block
+            # concurrent enqueues (they would inherit its stall as drops)
+            if not self.inner.send(chan_id, msg):
+                with self._cond:
+                    self.stats["send_fail"] += 1
+                self.close()
+                return
+            with self._cond:
+                self.stats["delivered"] += 1
+
+    # -- passthrough --
+
+    def recv(self, timeout: float | None = None):
+        return self.inner.recv(timeout)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._heap.clear()
+            self._cond.notify_all()
+        self.inner.close()
+
+    @property
+    def is_closed(self) -> bool:
+        return self._closed or self.inner.is_closed
+
+    def link(self) -> tuple[str, str]:
+        return (self._src, self._dst)
+
+
+class LinkShaper:
+    """Factory + live registry of shaped directed links.
+
+    One shaper serves a whole process (or a whole LocalNet): install with
+    ``Switch.set_link_shaper`` before peers connect; every subsequent
+    ``add_peer_conn`` wraps its connection. ``set_profile`` swaps the
+    weather LIVE — existing links read the current profile per frame, so
+    one long-lived net can walk the whole scenario matrix.
+
+    Per-link overrides (``links={"A->B": profile_or_name}``) express
+    asymmetric topologies (e.g. the stake-heavy validator behind the worst
+    link, arxiv 1903.04213's motivating case).
+    """
+
+    def __init__(
+        self,
+        profile: NetProfile | str = "lan",
+        seed: int = 0,
+        links: dict[str, NetProfile | str] | None = None,
+    ):
+        self.seed = int(seed)
+        self._mtx = make_lock("netem.LinkShaper._mtx")
+        self._profile = get_profile(profile)
+        self._links = {k: get_profile(v) for k, v in (links or {}).items()}
+        self._rngs: dict[tuple[str, str], random.Random] = {}
+        self._conns: list = []  # weakrefs to ShapedConnections
+
+    def _link_rng(self, src: str, dst: str) -> random.Random:
+        """One PRNG stream per directed link, surviving reconnects.
+
+        Domain-separated from FaultPlan._link_rng (``faultplan|...``) so a
+        shaper never consumes or perturbs chaos streams.
+        """
+        with self._mtx:
+            key = (src, dst)
+            rng = self._rngs.get(key)
+            if rng is None:
+                digest = hashlib.sha256(
+                    b"netem|%d|%s|%s"
+                    % (self.seed, src.encode(), dst.encode())
+                ).digest()
+                rng = random.Random(int.from_bytes(digest[:8], "big"))
+                self._rngs[key] = rng
+            return rng
+
+    def profile_for(self, src: str, dst: str) -> NetProfile:
+        with self._mtx:
+            return self._links.get(f"{src}->{dst}", self._profile)
+
+    def set_profile(
+        self,
+        profile: NetProfile | str,
+        links: dict[str, NetProfile | str] | None = None,
+    ) -> None:
+        """Swap the weather on every current and future link."""
+        with self._mtx:
+            self._profile = get_profile(profile)
+            self._links = {k: get_profile(v) for k, v in (links or {}).items()}
+
+    @property
+    def profile(self) -> NetProfile:
+        with self._mtx:
+            return self._profile
+
+    def wrap(self, conn, src: str, dst: str) -> ShapedConnection:
+        shaped = ShapedConnection(conn, self, src, dst)
+        with self._mtx:
+            self._conns = [r for r in self._conns if r() is not None]
+            self._conns.append(weakref.ref(shaped))
+        return shaped
+
+    def snapshot(self) -> dict:
+        """Aggregate + per-link shaping counters (health/metrics/bench)."""
+        with self._mtx:
+            conns = [r() for r in self._conns]
+            profile = self._profile.name
+        total = {k: 0 for k in _STAT_KEYS}
+        links = {}
+        for c in conns:
+            if c is None:
+                continue
+            src, dst = c.link()
+            per = links.setdefault(f"{src}->{dst}", {k: 0 for k in _STAT_KEYS})
+            for k in _STAT_KEYS:
+                v = c.stats[k]
+                per[k] += v
+                total[k] += v
+        return {"profile": profile, "seed": self.seed, "total": total, "links": links}

@@ -53,6 +53,10 @@ class LocalNet:
         epoch_config=None,  # EpochConfig: rotation/slashing (epoch/)
         sync: bool = True,  # catch-up sync channel + client (sync/)
         sync_config=None,  # SyncConfig override (sync/config.py)
+        netem=None,  # profile name / NetProfile / LinkShaper: WAN weather (netem/)
+        netem_seed: int = 0,  # shaper PRNG seed (ignored for a prebuilt LinkShaper)
+        net: bool | None = None,  # adaptive transport; None = on iff netem is set
+        net_config=None,  # NetTransportConfig override (p2p/adaptive.py)
     ):
         """n_nodes: host only the first n_nodes validators as full nodes
         (default: one node per validator). A large validator set does not
@@ -114,6 +118,30 @@ class LocalNet:
             self.chaos = fault_plan
             if regossip_interval is None:
                 regossip_interval = 0.25
+        # network weather (netem/): ONE shaper serves the whole net so a
+        # live set_profile() walks every link at once; installed on each
+        # switch at assembly (node_config) so PEX/reconnect links created
+        # later are shaped too. Weather implies frame loss below the
+        # reliable lane — default the anti-entropy re-walk on, like chaos.
+        self.shaper = None
+        if netem is not None:
+            from ..netem import LinkShaper
+
+            if isinstance(netem, LinkShaper):
+                self.shaper = netem
+            else:
+                self.shaper = LinkShaper(netem, seed=netem_seed)
+            if regossip_interval is None:
+                regossip_interval = 0.25
+            # in-proc pipes have no PEX ensure-loop: a peer torn down by a
+            # weather-corrupted frame must heal through the scoreboard's
+            # backoff re-dial instead
+            if health_config is None:
+                from ..health.config import HealthConfig
+
+                health_config = HealthConfig(redial_lost_peers=True)
+        self._net = bool(net) if net is not None else self.shaper is not None
+        self._net_config = net_config
         # rebuild inputs, kept so durable members can be crashed and
         # revived over their on-disk artifacts (make_durable/revive_node)
         self._cfg = cfg
@@ -191,6 +219,9 @@ class LocalNet:
                 epoch_config=self._epoch_config,
                 sync=self._sync,
                 sync_config=self._sync_config,
+                net=self._net,
+                net_config=self._net_config,
+                link_shaper=self.shaper,
             ),
             **dbs,
         )
@@ -209,9 +240,13 @@ class LocalNet:
         # health monitors can only heal links they can re-dial: give each
         # one a reconnector so peer-score evictions become reconnect
         # cycles instead of permanent degradation
+        roster = [n.switch.node_id for n in self.nodes]
         for node in self.nodes:
             if node.health is not None:
                 node.health.set_reconnector(self._make_reconnector(node))
+                # full-mesh roster: redial_lost_peers rigs heal links torn
+                # down before the scoreboard ever observed them
+                node.health.set_expected_peers(roster)
 
     def _make_reconnector(self, node: Node):
         """Closure handed to node's PeerScoreBoard: re-dial a peer by
@@ -290,6 +325,7 @@ class LocalNet:
                 connect_switches(node.switch, other.switch)
         if node.health is not None:
             node.health.set_reconnector(self._make_reconnector(node))
+            node.health.set_expected_peers([n.switch.node_id for n in self.nodes])
         self._down.discard(i)
         return node
 
@@ -298,6 +334,12 @@ class LocalNet:
             node.stop()
         if self.chaos is not None:
             self.chaos.uninstall()
+
+    def set_net_profile(self, profile, links=None) -> None:
+        """Swap the WAN weather live on every link (netem rigs only)."""
+        if self.shaper is None:
+            raise RuntimeError("LocalNet was built without netem")
+        self.shaper.set_profile(profile, links=links)
 
     # -- client helpers --
 

@@ -113,6 +113,19 @@ class NodeConfig:
     sync: bool = True
     # SyncConfig override (None = defaults; see sync/config.py)
     sync_config: object = None
+    # adaptive peer transport (p2p/adaptive.py): per-peer RTT/loss
+    # estimators + pinger, adaptive send timeouts, bounded send queues
+    # with oldest-bulk drop, slow-peer quarantine folded into the health
+    # scoreboard. Opt-in (False = exact legacy switch behavior) so seeded
+    # chaos drills stay bit-identical; the WAN matrix and netem rigs
+    # enable it
+    net: bool = False
+    # NetTransportConfig override (None = defaults; see p2p/adaptive.py)
+    net_config: object = None
+    # netem.LinkShaper (or None): wraps every peer connection in WAN
+    # weather — install at assembly so links created by PEX/reconnects
+    # are shaped too, not just the initial dials
+    link_shaper: object = None
 
 
 class Node:
@@ -295,6 +308,10 @@ class Node:
 
         # -- switch + reactors (node/node.go:688-722; wiring bug fixed) --
         self.switch = Switch(node_id, node_seed=nc.node_key_seed)
+        if nc.link_shaper is not None:
+            self.switch.set_link_shaper(nc.link_shaper)
+        if nc.net:
+            self.switch.configure_net(nc.net_config)
         mp_bcast = (
             nc.mempool_broadcast
             if nc.mempool_broadcast is not None

@@ -143,6 +143,36 @@ class ProcNet:
                 raise RuntimeError(f"restarted procnode {i} never rejoined the mesh")
             time.sleep(0.1)
 
+    # -- live weather control (netem/) --
+
+    def set_netem(self, profile: str, links: dict | None = None, timeout: float = 10.0) -> None:
+        """Swap every child's link weather live (children must have been
+        started with a ``netem`` spec). Writes one control line per child
+        and waits for each ack, so on return the whole fleet is on the new
+        profile (frames already in flight drain under the old one)."""
+        cmd = json.dumps({"cmd": "netem", "profile": profile, "links": links})
+        for child in self.children:
+            child.stdin.write(cmd + "\n")
+            child.stdin.flush()
+        for i, child in enumerate(self.children):
+            deadline = time.monotonic() + timeout
+            while True:
+                line = child.stdout.readline()
+                if not line:
+                    raise RuntimeError(
+                        f"procnode {i} died during netem swap:\n{self._stderr_tail(i)}"
+                    )
+                try:
+                    ack = json.loads(line)
+                except ValueError:
+                    continue  # stray print from the child: skip
+                if ack.get("ok") == "netem":
+                    break
+                if "err" in ack:
+                    raise RuntimeError(f"procnode {i} netem swap: {ack['err']}")
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"procnode {i} netem ack timed out")
+
     def stop(self, timeout: float = 15.0) -> None:
         for child in self.children:
             try:

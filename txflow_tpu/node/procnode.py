@@ -21,7 +21,17 @@ Spec line fields (all optional except index/n/seed_prefix):
      "regossip": 0.25,
      "data_dir": "/tmp/soak/node0",        # durable stores + WALs (wipe drills)
      "sync": {"lag_threshold": 1},         # SyncConfig kwargs (or false = off)
-     "blackhole": {"start": 3.0, "duration": 2.0}}
+     "blackhole": {"start": 3.0, "duration": 2.0},
+     "netem": {"profile": "lossy-edge", "seed": 11},  # WAN weather (netem/)
+     "net": true}                          # adaptive transport (p2p/adaptive.py)
+
+``netem`` installs a LinkShaper on the switch (before start, so every
+dialed/accepted link is shaped); ``net`` enables the adaptive transport
+(defaults ON whenever netem is set). After startup the park loop doubles
+as a control channel: each stdin line that parses as JSON is a live
+command — ``{"cmd": "netem", "profile": "congested"}`` swaps the weather
+and acks ``{"ok": "netem", "profile": ...}`` on stdout (ProcNet.set_netem
+drives this to walk one long-lived net through a scenario matrix).
 
 ``blackhole`` makes THIS child's chaos router partition itself away for
 the window: its outbound gossip black-holes, so its PEERS observe
@@ -108,6 +118,24 @@ def main() -> None:
         sync_config = SyncConfig(**sync_on)
         sync_on = True
 
+    shaper = None
+    netem_spec = spec.get("netem")
+    if netem_spec:
+        from ..netem import LinkShaper
+
+        shaper = LinkShaper(
+            netem_spec.get("profile", "lan"),
+            seed=int(netem_spec.get("seed", 0)),
+            links=netem_spec.get("links"),
+        )
+    net_on = spec.get("net", shaper is not None)
+    net_config = None
+    if isinstance(net_on, dict):
+        from ..p2p.adaptive import NetTransportConfig
+
+        net_config = NetTransportConfig(**net_on)
+        net_on = True
+
     node = Node(
         node_id=f"proc-{index}",
         chain_id=chain_id,
@@ -125,6 +153,9 @@ def main() -> None:
             health_config=health_config,
             sync=bool(sync_on),
             sync_config=sync_config,
+            net=bool(net_on),
+            net_config=net_config,
+            link_shaper=shaper,
         ),
         **dbs,
     )
@@ -165,9 +196,30 @@ def main() -> None:
 
         threading.Thread(target=_blackhole, name="blackhole", daemon=True).start()
 
-    # park until the parent closes our stdin
-    while sys.stdin.readline():
-        pass
+    # park until the parent closes our stdin; lines that parse as JSON
+    # commands are live controls (weather swaps), everything else ignored
+    while True:
+        line = sys.stdin.readline()
+        if not line:
+            break
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            cmd = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(cmd, dict):
+            continue
+        if cmd.get("cmd") == "netem":
+            if shaper is None:
+                print(json.dumps({"err": "netem not configured"}), flush=True)
+                continue
+            shaper.set_profile(cmd.get("profile", "lan"), links=cmd.get("links"))
+            print(
+                json.dumps({"ok": "netem", "profile": cmd.get("profile", "lan")}),
+                flush=True,
+            )
     node.stop()
 
 

@@ -1,0 +1,260 @@
+"""Adaptive peer transport: per-peer RTT/loss/backlog estimation feeding
+send timeouts, bounded send queues, and slow-peer quarantine.
+
+Opt-in at the Switch level (``Switch.configure_net``): a bare Switch keeps
+the exact legacy PriorityQueue/no-ping behavior, so every seeded chaos
+drill that predates this module is bit-identical. When configured:
+
+- a pinger thread sends one PING frame per peer per interval THROUGH the
+  normal send path (lowest priority, chaos-interceptable — a black-holed
+  link loses its pings too, so the PR 2/6 staleness machinery still sees
+  silence as silence);
+- ``PeerNetEstimator`` folds PONG RTTs into RFC 6298-style srtt/rttvar,
+  ping expiries into a loss EWMA, and samples queue backlog — yielding a
+  per-peer adaptive send timeout (clamped) that the send loop passes down
+  to ``TCPConnection.send``;
+- ``BoundedSendQueue`` replaces the per-peer shared-lane PriorityQueue:
+  under backpressure it drops the OLDEST frame from the LEAST-important
+  lane not more important than the newcomer (PR 6 semantics: the priority
+  lane is preserved; the reliable consensus lane is a separate queue and
+  untouched);
+- sustained bad weather (loss/RTT over thresholds, with hysteresis) marks
+  the peer ``quarantined``; the health scoreboard (health/peers.py) folds
+  that into the existing score-floor/eviction/backoff machinery rather
+  than inventing a second eviction path.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import struct
+import threading
+from collections import deque
+from dataclasses import dataclass
+
+from ..utils import clock
+
+_PING_FMT = struct.Struct("!I")
+
+
+@dataclass(frozen=True)
+class NetTransportConfig:
+    ping_interval: float = 1.0  # one PING per peer per interval
+    ping_timeout: float = 3.0  # outstanding longer than this = lost
+    max_outstanding: int = 8  # stop pinging a silent peer past this
+    rtt_alpha: float = 0.125  # RFC 6298 SRTT gain
+    rtt_beta: float = 0.25  # RFC 6298 RTTVAR gain
+    loss_alpha: float = 0.2  # loss EWMA gain per ping outcome
+    min_send_timeout: float = 0.5
+    max_send_timeout: float = 10.0
+    quarantine_loss: float = 0.5  # loss EWMA at/over this is "bad"
+    quarantine_rtt: float = 2.0  # seconds of SRTT at/over this is "bad"
+    quarantine_after: int = 3  # consecutive bad ticks to enter
+    requalify_after: int = 4  # consecutive good ticks to leave
+    queue_capacity: int = 4096  # bounded shared-lane depth (frames)
+
+
+class PeerNetEstimator:
+    """One peer's link-quality state. Mutated from the pinger thread and
+    the peer's recv loop; a plain lock guards the short update sections
+    (no blocking calls inside — chaos.py precedent for unaudited locks)."""
+
+    def __init__(self, cfg: NetTransportConfig):
+        self.cfg = cfg
+        self._mtx = threading.Lock()
+        self.srtt: float | None = None
+        self.rttvar = 0.0
+        self.loss = 0.0
+        self.backlog = 0
+        self.quarantined = False
+        self.transitions = 0  # quarantine enter/leave count
+        self.pings_sent = 0
+        self.pongs = 0
+        self.ping_timeouts = 0
+        self._outstanding: dict[int, float] = {}
+        self._seq = itertools.count(1)
+        self._bad = 0
+        self._good = 0
+
+    def next_ping(self, now: float) -> bytes | None:
+        """Payload for the next PING, or None while the peer is so far
+        behind that more probes would only inflate the loss estimate."""
+        with self._mtx:
+            if len(self._outstanding) >= self.cfg.max_outstanding:
+                return None
+            nonce = next(self._seq) & 0xFFFFFFFF
+            self._outstanding[nonce] = now
+            self.pings_sent += 1
+            return _PING_FMT.pack(nonce)
+
+    def on_pong(self, payload: bytes, now: float) -> None:
+        if len(payload) != _PING_FMT.size:
+            return
+        (nonce,) = _PING_FMT.unpack(payload)
+        cfg = self.cfg
+        with self._mtx:
+            t = self._outstanding.pop(nonce, None)
+            if t is None:
+                return  # late pong already counted as a loss
+            self.pongs += 1
+            rtt = max(now - t, 0.0)
+            if self.srtt is None:
+                self.srtt = rtt
+                self.rttvar = rtt / 2.0
+            else:
+                self.rttvar = (1.0 - cfg.rtt_beta) * self.rttvar + cfg.rtt_beta * abs(
+                    self.srtt - rtt
+                )
+                self.srtt = (1.0 - cfg.rtt_alpha) * self.srtt + cfg.rtt_alpha * rtt
+            self.loss = (1.0 - cfg.loss_alpha) * self.loss
+
+    def expire(self, now: float) -> None:
+        cfg = self.cfg
+        with self._mtx:
+            dead = [
+                n
+                for n, t in self._outstanding.items()
+                if now - t > cfg.ping_timeout
+            ]
+            for n in dead:
+                del self._outstanding[n]
+                self.ping_timeouts += 1
+                self.loss = (1.0 - cfg.loss_alpha) * self.loss + cfg.loss_alpha
+
+    def send_timeout(self) -> float:
+        """Adaptive whole-frame send timeout: generous before the first
+        RTT sample, then 2*SRTT + 4*RTTVAR (+grace), clamped."""
+        cfg = self.cfg
+        with self._mtx:
+            if self.srtt is None:
+                return cfg.max_send_timeout
+            raw = 2.0 * self.srtt + 4.0 * self.rttvar + 0.25
+        return min(max(raw, cfg.min_send_timeout), cfg.max_send_timeout)
+
+    def note_tick(self, backlog: int) -> None:
+        """Once per pinger tick: sample backlog, run quarantine hysteresis."""
+        cfg = self.cfg
+        with self._mtx:
+            self.backlog = backlog
+            bad = self.loss >= cfg.quarantine_loss or (
+                self.srtt is not None and self.srtt >= cfg.quarantine_rtt
+            )
+            if bad:
+                self._bad += 1
+                self._good = 0
+            else:
+                self._good += 1
+                self._bad = 0
+            if not self.quarantined and self._bad >= cfg.quarantine_after:
+                self.quarantined = True
+                self.transitions += 1
+            elif self.quarantined and self._good >= cfg.requalify_after:
+                self.quarantined = False
+                self.transitions += 1
+
+    def snapshot(self) -> dict:
+        cfg = self.cfg
+        with self._mtx:
+            if self.srtt is None:
+                timeout = cfg.max_send_timeout
+            else:
+                timeout = min(
+                    max(
+                        2.0 * self.srtt + 4.0 * self.rttvar + 0.25,
+                        cfg.min_send_timeout,
+                    ),
+                    cfg.max_send_timeout,
+                )
+            return {
+                "rtt_ms": None if self.srtt is None else self.srtt * 1e3,
+                "rttvar_ms": self.rttvar * 1e3,
+                "loss": self.loss,
+                "backlog": self.backlog,
+                "send_timeout_s": timeout,
+                "quarantined": self.quarantined,
+                "transitions": self.transitions,
+                "pings_sent": self.pings_sent,
+                "pongs": self.pongs,
+                "ping_timeouts": self.ping_timeouts,
+                "outstanding": len(self._outstanding),
+            }
+
+
+class BoundedSendQueue:
+    """Priority send queue with oldest-bulk drop instead of blocking.
+
+    Drop-in for the per-peer shared-lane PriorityQueue (items are
+    ``(prio, seq, chan_id, msg)`` with LOWER prio = MORE important). When
+    full, a newcomer evicts the OLDEST frame of the numerically-largest
+    (least important) lane — but never a frame more important than
+    itself: if everything queued outranks it, the newcomer is rejected
+    (queue.Full), which the peer counts as send_fail exactly like the
+    legacy queue. ``put`` therefore never blocks; its ``timeout`` arg is
+    accepted for interface parity and ignored.
+    """
+
+    def __init__(self, capacity: int):
+        self._capacity = max(int(capacity), 1)
+        self._buckets: dict[int, deque] = {}
+        self._size = 0
+        self._cond = threading.Condition()
+        self.dropped = 0  # evicted-oldest frames (txflow_net_sendq_dropped)
+
+    def put_nowait(self, item) -> None:
+        prio = item[0]
+        with self._cond:
+            if self._size >= self._capacity:
+                worst = max(self._buckets)
+                if worst < prio:
+                    raise queue.Full  # everything queued outranks newcomer
+                dq = self._buckets[worst]
+                dq.popleft()
+                if not dq:
+                    del self._buckets[worst]
+                self._size -= 1
+                self.dropped += 1
+            self._buckets.setdefault(prio, deque()).append(item)
+            self._size += 1
+            self._cond.notify()
+
+    def put(self, item, timeout: float | None = None) -> None:
+        self.put_nowait(item)
+
+    def get(self, timeout: float | None = None):
+        with self._cond:
+            if not self._size:
+                self._cond.wait(timeout)
+                if not self._size:
+                    raise queue.Empty
+            best = min(self._buckets)
+            dq = self._buckets[best]
+            item = dq.popleft()
+            if not dq:
+                del self._buckets[best]
+            self._size -= 1
+            return item
+
+    def qsize(self) -> int:
+        return self._size
+
+
+def run_pinger(switch, stop: threading.Event) -> None:
+    """Pinger loop body (one thread per configured Switch): every interval,
+    expire stale probes, run quarantine ticks, and ping each peer through
+    the NORMAL send path (lowest priority; chaos/shaper see it like any
+    other frame, so probe loss tracks real frame loss)."""
+    from .switch import _PING_CHANNEL  # late: avoid import cycle
+
+    cfg = switch._net_config
+    while not stop.wait(cfg.ping_interval):
+        for peer in switch.peers():
+            net = peer.net
+            if net is None:
+                continue
+            now = clock.monotonic()
+            net.expire(now)
+            net.note_tick(peer._send_q.qsize())
+            payload = net.next_ping(now)
+            if payload is not None:
+                peer.try_send(_PING_CHANNEL, payload)

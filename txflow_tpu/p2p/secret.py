@@ -243,7 +243,29 @@ class SecretConnection:
             self.close()
             return False
 
-    try_send = send
+    def try_send(self, chan_id: int, msg: bytes) -> bool:
+        """Best-effort send: skips (False) when another sender holds the
+        write lock. Unlike TCPConnection.try_send it cannot probe the
+        kernel buffer first — AEAD nonces must advance in lockstep with
+        wire bytes, so a frame once encrypted is always written in full."""
+        if self._closed.is_set():
+            return False
+        if len(msg) > MAX_FRAME_BYTES:
+            raise ValueError(f"frame too large: {len(msg)}")
+        if not self._wlock.acquire(blocking=False):
+            return False
+        try:
+            ct = self._send_aead.encrypt(
+                self._nonce(self._send_ctr), bytes([chan_id]) + msg, b""
+            )
+            self._send_ctr += 1
+            self._sock.sendall(_LEN.pack(len(ct)) + ct)  # txlint: allow(lock-blocking) -- same nonce/wire lockstep contract as _send_frame
+            return True
+        except OSError:
+            self.close()
+            return False
+        finally:
+            self._wlock.release()
 
     def recv(self, timeout: float | None = None) -> tuple[int, bytes]:
         if self._closed.is_set():

@@ -30,6 +30,10 @@ from .transport import (
 
 _HANDSHAKE_CHANNEL = 0xFF
 _WAKE_CHANNEL = 0xFE  # internal sentinel: wakes a send loop, never sent
+# link-quality probes (p2p/adaptive.py): sent only by switches with
+# configure_net(); every switch answers PING so mixed fleets interoperate
+_PING_CHANNEL = 0xFD
+_PONG_CHANNEL = 0xFC
 
 
 class PeerStats:
@@ -69,13 +73,31 @@ class Peer:
 
     _id_counter = itertools.count(1)
 
-    def __init__(self, conn, node_id: str, outbound: bool, channels: dict[int, ChannelDescriptor]):
+    def __init__(
+        self,
+        conn,
+        node_id: str,
+        outbound: bool,
+        channels: dict[int, ChannelDescriptor],
+        net_config=None,
+    ):
         self.conn = conn
         self.node_id = node_id
         self.outbound = outbound
         self.kv: dict[str, object] = {}  # peer state (reference peer.Set/Get)
         self._channels = channels
-        self._send_q: queue.PriorityQueue = queue.PriorityQueue(maxsize=4096)
+        if net_config is not None:
+            # adaptive transport (p2p/adaptive.py): bounded shared lane
+            # with oldest-bulk drop + per-peer link estimator. Opt-in —
+            # the legacy blocking PriorityQueue below stays bit-identical
+            # for unconfigured switches.
+            from .adaptive import BoundedSendQueue, PeerNetEstimator
+
+            self._send_q = BoundedSendQueue(net_config.queue_capacity)
+            self.net = PeerNetEstimator(net_config)
+        else:
+            self._send_q = queue.PriorityQueue(maxsize=4096)
+            self.net = None
         # lane for reliable channels (consensus): never dropped under BULK
         # pressure (its pressure is its own), drained ahead of the shared
         # queue. Bounded all the same — a stalled peer must not grow memory
@@ -205,6 +227,9 @@ class Switch:
         self._mtx = make_rlock("p2p.Switch._mtx")
         self._running = False
         self._fault_injector = None
+        self._link_shaper = None  # netem.LinkShaper, wraps future conns
+        self._net_config = None  # adaptive.NetTransportConfig (opt-in)
+        self._net_stop: threading.Event | None = None
 
     # -- reactor registry (reference Switch.AddReactor) --
 
@@ -239,6 +264,8 @@ class Switch:
                 return
             self._running = False
             peers = list(self._peers.values())
+        if self._net_stop is not None:
+            self._net_stop.set()
         self.close_listener()
         for p in peers:
             self.stop_peer(p, reason="switch stopping")
@@ -279,9 +306,66 @@ class Switch:
                 else injector.make_interceptor(self.node_id, p.node_id)
             )
 
+    def set_link_shaper(self, shaper) -> None:
+        """Install a netem.LinkShaper: every FUTURE peer connection is
+        wrapped in its directed-link weather (install before connecting —
+        existing links keep their raw transport). Clear with None."""
+        with self._mtx:
+            self._link_shaper = shaper
+
+    def configure_net(self, config=None) -> None:
+        """Enable the adaptive peer transport (p2p/adaptive.py): bounded
+        per-peer send queues, RTT/loss/backlog estimators fed by a pinger
+        thread, adaptive send timeouts, and quarantine flags the health
+        scoreboard folds into score-floor eviction. Opt-in: a bare Switch
+        keeps exact legacy queue/no-ping behavior."""
+        from .adaptive import NetTransportConfig, run_pinger
+
+        with self._mtx:
+            if self._net_config is not None:
+                self._net_config = config or NetTransportConfig()
+                return
+            self._net_config = config or NetTransportConfig()
+            self._net_stop = threading.Event()
+        threading.Thread(
+            target=run_pinger,
+            args=(self, self._net_stop),
+            name=f"p2p-ping-{self.node_id}",
+            daemon=True,
+        ).start()
+
+    def net_snapshot(self) -> dict:
+        """Per-peer link-quality + shaping counters (health /metrics/bench)."""
+        out: dict = {
+            "configured": self._net_config is not None,
+            "peers": {},
+            "quarantined": 0,
+            "sendq_dropped": 0,
+        }
+        for p in self.peers():
+            dropped = getattr(p._send_q, "dropped", 0)
+            out["sendq_dropped"] += dropped
+            net = p.net
+            if net is None:
+                continue
+            snap = net.snapshot()
+            snap["sendq_dropped"] = dropped
+            snap["backlog"] = p._send_q.qsize()
+            out["peers"][p.node_id] = snap
+            if snap["quarantined"]:
+                out["quarantined"] += 1
+        shaper = self._link_shaper
+        if shaper is not None:
+            out["shaper"] = shaper.snapshot()
+        return out
+
     def add_peer_conn(self, conn, node_id: str, outbound: bool) -> Peer:
         """Attach a live connection as a peer and start its loops."""
-        peer = Peer(conn, node_id, outbound, dict(self._channels))
+        if self._link_shaper is not None:
+            conn = self._link_shaper.wrap(conn, self.node_id, node_id)
+        peer = Peer(
+            conn, node_id, outbound, dict(self._channels), net_config=self._net_config
+        )
         if self._fault_injector is not None:
             peer.intercept = self._fault_injector.make_interceptor(
                 self.node_id, node_id
@@ -435,7 +519,9 @@ class Switch:
                     continue
                 if chan_id == _WAKE_CHANNEL:
                     continue
-            if not peer.conn.send(chan_id, msg):
+            net = peer.net
+            timeout = 10.0 if net is None else net.send_timeout()
+            if not peer.conn.send(chan_id, msg, timeout):
                 peer.stats.send_fail += 1
                 self.stop_peer(peer, reason="send failed")
                 return
@@ -453,6 +539,18 @@ class Switch:
             st = peer.stats
             st.recv_count += 1
             st.last_recv = time.monotonic()
+            if chan_id == _PING_CHANNEL:
+                # answer through the full send path (interceptor included):
+                # the pong rides OUR outbound direction, so a cut or shaped
+                # reverse link must cost pongs — that asymmetry is exactly
+                # what the prober's loss estimate should see
+                peer.try_send(_PONG_CHANNEL, msg)
+                continue
+            if chan_id == _PONG_CHANNEL:
+                net = peer.net
+                if net is not None:
+                    net.on_pong(msg, time.monotonic())
+                continue
             reactor = self._chan_to_reactor.get(chan_id)
             if reactor is None:
                 continue  # unknown channel: ignore (switch filters by NodeInfo upstream)

@@ -115,10 +115,15 @@ class TCPConnection:
         self._rfile = sock.makefile("rb")
         self._wlock = make_lock("p2p.TCPConnection._wlock", allow_blocking=True)
         self._closed = threading.Event()
+        self._snd_timeout: float | None = None  # last SO_SNDTIMEO armed
         self.label = label
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def send(self, chan_id: int, msg: bytes, timeout: float | None = 10.0) -> bool:
+        """Blocking send; ``timeout`` bounds the whole-frame write. A
+        timeout mid-frame leaves the peer's stream desynced, so it closes
+        the connection (False) rather than retry — the adaptive transport
+        (p2p/adaptive.py) passes per-peer RTT-derived timeouts here."""
         if self._closed.is_set():
             return False
         if len(msg) > MAX_FRAME_BYTES:
@@ -129,14 +134,65 @@ class TCPConnection:
         note_blocking("p2p.socket-send")
         try:
             with self._wlock:
+                if timeout is not None:
+                    # SO_SNDTIMEO (NOT settimeout: that would also arm a
+                    # timeout on the recv loop's blocked read) bounds each
+                    # send syscall; an expiry surfaces as EAGAIN/OSError
+                    self._set_send_timeout(timeout)
                 self._sock.sendall(frame)  # txlint: allow(lock-blocking) -- _wlock EXISTS to serialize whole-frame writes; interleaved sendall would corrupt the stream
             return True
-        except OSError:
+        except OSError:  # includes a SO_SNDTIMEO expiry (EAGAIN)
             self.close()
             return False
 
-    # TCP sends are already buffered by the kernel; try_send == send.
-    try_send = send
+    def _set_send_timeout(self, timeout: float) -> None:
+        if timeout == self._snd_timeout:
+            return
+        sec = int(timeout)
+        try:
+            self._sock.setsockopt(
+                socket.SOL_SOCKET,
+                socket.SO_SNDTIMEO,
+                struct.pack("ll", sec, int((timeout - sec) * 1e6)),
+            )
+            self._snd_timeout = timeout
+        except (OSError, struct.error):
+            pass  # platform without the sockopt: sends stay unbounded
+
+    def try_send(self, chan_id: int, msg: bytes) -> bool:
+        """Non-blocking best-effort send (parity with InMemoryConnection):
+        False when another sender holds the write lock or the kernel
+        buffer can't take the first byte — the stream stays intact either
+        way. Once ANY byte of the frame is on the wire the frame must be
+        completed (blocking), else the receiver desyncs."""
+        if self._closed.is_set():
+            return False
+        if len(msg) > MAX_FRAME_BYTES:
+            raise ValueError(f"frame too large: {len(msg)}")
+        frame = _FRAME_HDR.pack(chan_id, len(msg)) + msg
+        if not self._wlock.acquire(blocking=False):
+            return False
+        try:
+            try:
+                # MSG_DONTWAIT: a per-call non-blocking probe that leaves
+                # the socket's timeout state alone (settimeout would also
+                # flip the recv loop's blocked read into non-blocking)
+                try:
+                    sent = self._sock.send(
+                        frame, getattr(socket, "MSG_DONTWAIT", 0)
+                    )
+                except (BlockingIOError, InterruptedError):
+                    return False  # kernel buffer full, nothing written
+                if sent < len(frame):
+                    # committed: finish the frame so the stream stays framed
+                    note_blocking("p2p.socket-send")
+                    self._sock.sendall(frame[sent:])  # txlint: allow(lock-blocking) -- same frame-integrity contract as send()
+                return True
+            except OSError:
+                self.close()
+                return False
+        finally:
+            self._wlock.release()
 
     def recv(self, timeout: float | None = None) -> tuple[int, bytes]:
         prev_timeout = self._sock.gettimeout()

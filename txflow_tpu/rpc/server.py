@@ -302,6 +302,7 @@ class RPCServer:
             "/commit": self._commit,
             "/genesis": self._genesis,
             "/net_info": self._net_info,
+            "/commit_log": self._commit_log,
             "/block_results": self._block_results,
             "/unconfirmed_txs": self._unconfirmed_txs,
             "/num_unconfirmed_txs": self._num_unconfirmed_txs,
@@ -729,6 +730,29 @@ class RPCServer:
         import json as _json
 
         return {"genesis": _json.loads(self.node.genesis.to_json())}
+
+    def _commit_log(self, q: dict) -> dict:
+        """This node's fast-path commit-order log (store S: rows). There
+        is no GLOBAL total order across fast-path nodes (sync/manager.py)
+        — each node's log is its own decision order — so cross-node
+        checks compare committed SETS plus per-node prefix stability; the
+        WAN matrix (tools/soak.py --wan-matrix) reads this per scenario.
+        ``start``/``count`` window the read; ``count=0`` returns just the
+        total + digest-to-date (cheap prefix-equality probe)."""
+        store = self.node.tx_store
+        total = store.seq_count()
+        start = max(int(q.get("start", 0)), 0)
+        count = int(q.get("count", max(total - start, 0)))
+        hashes = [h for _seq, h in store.committed_range(start, count)]
+        digest = hashlib.sha256()
+        for h in store.committed_range(0, total):
+            digest.update(h[1].encode())
+        return {
+            "total": total,
+            "start": start,
+            "hashes": hashes,
+            "digest": digest.hexdigest(),
+        }
 
     def _net_info(self, q: dict) -> dict:
         peers = self.node.switch.peers()

@@ -265,6 +265,60 @@ class HealthMetrics:
         self.pipeline_depth_now = r.gauge("health", "pipeline_depth", "engine's current (possibly adaptive) pipeline depth")
 
 
+class NetMetrics:
+    """Network-weather metrics (p2p/adaptive.py + netem/ subsystems).
+
+    All values mirror the switch's ``net_snapshot()`` — counters live as
+    plain ints on estimators/shapers (bumped lock-free on hot paths) and
+    are republished as absolute gauges on each health tick, so /metrics,
+    /health's "network" section, and bench stamps read one source."""
+
+    def __init__(self, registry: "Registry | None" = None):
+        r = registry or GLOBAL
+        self.peers = r.gauge("net", "peers", "peers with link estimators")
+        self.quarantined = r.gauge("net", "quarantined_peers", "peers currently quarantined for bad weather")
+        self.quarantine_transitions = r.gauge("net", "quarantine_transitions", "quarantine enter/leave events (all peers)")
+        self.rtt_ms_max = r.gauge("net", "peer_rtt_ms_max", "worst per-peer smoothed RTT (ms)")
+        self.loss_max = r.gauge("net", "peer_loss_max", "worst per-peer ping-loss EWMA")
+        self.pings_sent = r.gauge("net", "pings_sent", "link probes sent (all peers)")
+        self.pongs = r.gauge("net", "pongs", "link probe replies received (all peers)")
+        self.ping_timeouts = r.gauge("net", "ping_timeouts", "link probes expired unanswered (all peers)")
+        self.sendq_dropped = r.gauge("net", "sendq_dropped", "oldest-bulk frames dropped by bounded send queues")
+        self.shaped_frames = r.gauge("net", "shaped_frames", "frames through the link shaper")
+        self.shaped_dropped = r.gauge("net", "shaped_dropped", "frames lost by shaper weather (random loss)")
+        self.shaped_flap_dropped = r.gauge("net", "shaped_flap_dropped", "frames lost in shaper flap down-windows")
+        self.shaped_queue_dropped = r.gauge("net", "shaped_queue_dropped", "frames tail-dropped by shaper pacing queues")
+        self.shaped_duplicated = r.gauge("net", "shaped_duplicated", "frames duplicated by the shaper")
+        self.shaped_corrupted = r.gauge("net", "shaped_corrupted", "frames with a shaper-flipped payload byte")
+
+    def refresh_from(self, snap: dict) -> None:
+        """Republish a Switch.net_snapshot() as absolute gauge values."""
+        peers = snap.get("peers", {})
+        self.peers.set(len(peers))
+        self.quarantined.set(snap.get("quarantined", 0))
+        self.sendq_dropped.set(snap.get("sendq_dropped", 0))
+        rtts = [p["rtt_ms"] for p in peers.values() if p.get("rtt_ms") is not None]
+        self.rtt_ms_max.set(max(rtts) if rtts else 0.0)
+        losses = [p.get("loss", 0.0) for p in peers.values()]
+        self.loss_max.set(max(losses) if losses else 0.0)
+        for field, attr in (
+            ("transitions", self.quarantine_transitions),
+            ("pings_sent", self.pings_sent),
+            ("pongs", self.pongs),
+            ("ping_timeouts", self.ping_timeouts),
+        ):
+            attr.set(sum(p.get(field, 0) for p in peers.values()))
+        shaper = snap.get("shaper")
+        if shaper is not None:
+            total = shaper.get("total", {})
+            self.shaped_frames.set(total.get("frames", 0))
+            self.shaped_dropped.set(total.get("dropped", 0))
+            self.shaped_flap_dropped.set(total.get("flap_dropped", 0))
+            self.shaped_queue_dropped.set(total.get("queue_dropped", 0))
+            self.shaped_duplicated.set(total.get("duplicated", 0))
+            self.shaped_corrupted.set(total.get("corrupted", 0))
+
+
 class AdmissionMetrics:
     """Front-door admission metrics (admission/ subsystem).
 
