@@ -191,11 +191,32 @@ def main() -> None:
                 f"sign={s['prep_sign_s']:.3f}s "
                 f"pool_wait={s['prep_pool_wait_s']:.3f}s]"
             )
+        la = s.get("lanes") or {}
+        if la.get("enabled"):
+            # lane split: priority-lane dispatches + the live per-lane
+            # lingers (adaptive_linger moves these at runtime)
+            line += (
+                f" lanes[prio_batches={la['prio_batches']} "
+                f"prio_votes={la['prio_votes']} "
+                f"prio_linger={la['prio_linger_ms']}ms "
+                f"bulk_linger={la['bulk_linger_ms']}ms]"
+            )
+        sp = s.get("spec") or {}
+        if sp.get("enabled"):
+            line += (
+                f" spec[commits={sp['commits']} saved={sp['saved_s']:.3f}s]"
+            )
         ad = s.get("adaptive_depth")
         if ad is not None:
             line += (
                 f" adaptive[depth={ad['depth']} changes={ad['changes']} "
                 f"win_ratio={ad['last_window_ratio']}]"
+            )
+        al = s.get("adaptive_linger")
+        if al is not None:
+            line += (
+                f" adaptive_linger[prio={al['prio_linger_ms']}ms "
+                f"bulk={al['bulk_linger_ms']}ms adj={al['adjustments']}]"
             )
         print(line)
     # critical-path attribution (trace/report.py): folds each node's

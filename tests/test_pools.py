@@ -365,6 +365,17 @@ def test_votepool_lane_eviction_parity():
         assert not p.in_cache(vote_key(bulk[0]))  # re-deliverable
         items, _ = p.priority_entries_from(0, limit=10)
         assert [k for k, _v, _h, _s in items] == [vote_key(prio)]
+        # ingest-time lane freezing (both twins must stamp it): the
+        # priority log + the bulk walk are an exact partition of the
+        # live entries, even after the hook's answer changes
+        assert p.prio_seq() == 1
+        p.lane_of_vote = lambda v: LANE_PRIORITY  # drift: all prio now
+        bitems, _ = p.bulk_entries_from(0, limit=10)
+        bulk_keys = [k for k, _v, _h, _s in bitems]
+        assert vote_key(prio) not in bulk_keys  # frozen prio stays out
+        assert set(bulk_keys) == {
+            vote_key(bulk[1]), vote_key(bulk[2])
+        }  # frozen bulk stays in, despite the hook now saying priority
 
 
 def test_votepool_wal_degradation_parity(tmp_path):

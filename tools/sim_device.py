@@ -262,6 +262,57 @@ def print_crossover(fixed_s, psum_s, per_slot_s, host_us_per_vote,
               f"scale host workers (--host-workers), not devices")
 
 
+def lane_latency_model(arrival_vps: float, linger_s: float, fixed_s: float,
+                       per_slot_s: float, mesh: int = 1,
+                       bucket_cap: int = 512) -> dict:
+    """Predicted priority-lane commit p50 under a lane linger (ISSUE 12).
+
+    A vote that lands on the lane waits out the residual linger (uniform
+    arrival within the hold window: half the effective hold on average,
+    full at worst), then rides one dispatch (fixed + batch*per_slot/mesh)
+    and the readback/route tail folded into fixed_s. The effective hold
+    ends EARLY when the backlog fills a bucket: at arrival rate a and
+    linger L the coalesced batch is min(a*L, cap), so the hold is
+    min(L, cap/a). Returns the predicted p50/p99 and the dispatch rate —
+    the sweep printer uses it to find the linger sweet spot where the
+    added hold stops buying batch occupancy."""
+    a = max(arrival_vps, 1e-9)
+    hold_s = min(linger_s, bucket_cap / a)
+    batch = max(1.0, min(a * hold_s, float(bucket_cap)))
+    dispatch_s = fixed_s + batch * per_slot_s / max(1, mesh)
+    # mean residual hold for uniform arrivals = hold/2 (p50), ~full hold
+    # for the unluckiest arrivals (p99 ≈ first-in vote)
+    p50_s = hold_s / 2.0 + dispatch_s
+    p99_s = hold_s + dispatch_s
+    return {
+        "linger_ms": round(linger_s * 1e3, 3),
+        "batch": round(batch, 1),
+        "dispatches_per_s": round(a / batch, 1),
+        "p50_ms": round(p50_s * 1e3, 3),
+        "p99_ms": round(p99_s * 1e3, 3),
+    }
+
+
+def print_lane_sweep(arrival_vps: float, fixed_s: float, per_slot_s: float,
+                     mesh: int = 1, bucket_cap: int = 512) -> None:
+    """Sweep the priority-lane linger over the tuning range and print
+    the predicted p50 curve — the knob's sweet spot before a live
+    bench.py --latency-slo run confirms it."""
+    print(f"priority-lane linger sweep at {arrival_vps:,.0f} votes/s "
+          f"(mesh={mesh}, bucket_cap={bucket_cap}):")
+    best = None
+    for ms in (0.25, 0.5, 1.0, 2.0, 4.0, 8.0):
+        r = lane_latency_model(arrival_vps, ms / 1e3, fixed_s, per_slot_s,
+                               mesh, bucket_cap)
+        if best is None or r["p50_ms"] < best["p50_ms"]:
+            best = r
+        print(f"  linger={ms:5.2f} ms  batch={r['batch']:7.1f}  "
+              f"dispatch/s={r['dispatches_per_s']:8.1f}  "
+              f"p50={r['p50_ms']:7.2f} ms  p99={r['p99_ms']:7.2f} ms")
+    print(f"  sweet spot: linger={best['linger_ms']} ms "
+          f"(p50 {best['p50_ms']} ms)")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--fixed-ms", type=float, default=8.0)
@@ -276,7 +327,19 @@ def main():
     ap.add_argument("--host-us-per-vote", type=float, default=41.0,
                     help="host prep cost per vote (sign-bytes + compact prep; "
                          "~41 us/vote gives the ROADMAP's 18.4k host-bound)")
+    ap.add_argument("--lane-sweep", action="store_true",
+                    help="print the priority-lane linger sweep (predicted "
+                         "p50 vs lane linger at --lane-arrival-vps)")
+    ap.add_argument("--lane-arrival-vps", type=float, default=800.0,
+                    help="priority-lane offered load for --lane-sweep")
+    ap.add_argument("--lane-bucket-cap", type=int, default=512,
+                    help="priority_bucket_cap for --lane-sweep")
     args = ap.parse_args()
+    if args.lane_sweep:
+        print_lane_sweep(args.lane_arrival_vps, args.fixed_ms / 1e3,
+                         args.per_slot_us / 1e6, args.mesh_devices,
+                         args.lane_bucket_cap)
+        return
     for shared in (True, False):
         r = run(shared, args.txs, args.fixed_ms / 1e3, args.per_slot_us / 1e6,
                 args.mesh_devices, args.psum_ms / 1e3)

@@ -420,6 +420,10 @@ _HOT_FUNCS = {
     "txflow_tpu/engine/txflow.py": {
         "_run_pipelined", "_form_batch", "step", "_prep_batch",
         "_submit_prep", "_collect", "_route_result",
+        # lane-split + speculative-commit helpers (ISSUE 12): all run
+        # inside the fill/route stages of the pipelined loop
+        "_prio_pending", "_bulk_pending", "_bulk_quantum",
+        "_steer_lingers",
     },
 }
 
@@ -510,6 +514,10 @@ class HotPathPass(LintPass):
 _TRACE_SCOPE = (
     "txflow_tpu/engine/txflow.py",
     "txflow_tpu/engine/hostprep.py",
+    # the linger controller's cadence gate shares the engine's traced
+    # timeline (maybe_observe takes `now` from the caller, but any future
+    # internal timestamp must come through the same seam)
+    "txflow_tpu/engine/adaptive.py",
     "txflow_tpu/trace/",
     "txflow_tpu/admission/controller.py",
     "txflow_tpu/pool/",

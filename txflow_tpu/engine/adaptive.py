@@ -93,3 +93,107 @@ class AdaptiveDepthController:
                 round(self.last_ratio, 4) if self.last_ratio is not None else None
             ),
         }
+
+
+class AdaptiveLingerController:
+    """Per-lane linger steering against the SLO budget (ISSUE 12).
+
+    The lane lingers trade latency for batch occupancy: a longer hold
+    coalesces more votes per dispatch (throughput) at the cost of every
+    held vote's commit latency. The right trade moves with load, so this
+    controller closes the loop from the engine's own trace digest
+    (tracer.digest()["latency_ms"]): the engine calls ``maybe_observe``
+    once per collected batch, the controller rate-limits itself to one
+    digest pull per ``interval`` seconds (quantile computation is not
+    free) and steers both lane lingers multiplicatively:
+
+    - observed p50 over ``slo_budget_ms``: latency is the binding
+      constraint — shrink both lingers toward ``min_linger`` (the
+      priority lane faster than bulk: it is the lane the SLO is for);
+    - p50 under half the budget: headroom — relax each linger back
+      toward its CONFIGURED target (never past it: the targets are the
+      throughput-tuned defaults, not a ceiling to overshoot);
+    - in between, or no sampled data yet: hold.
+
+    Same design contract as AdaptiveDepthController above: synchronous,
+    engine-thread-owned, no lock — tests drive it with synthetic p50
+    sequences. Clock values come from the caller (utils.clock seam)."""
+
+    def __init__(
+        self,
+        slo_budget_ms: float = 50.0,
+        prio_linger: float = 0.001,
+        bulk_linger: float = 0.004,
+        min_linger: float = 0.0002,
+        interval: float = 0.25,
+        shrink: float = 0.5,
+        relax: float = 1.25,
+        family: str = "e2e",
+    ):
+        self.slo_budget_ms = float(slo_budget_ms)
+        self.prio_target = float(prio_linger)
+        self.bulk_target = float(bulk_linger)
+        self.prio_linger = float(prio_linger)
+        self.bulk_linger = float(bulk_linger)
+        self.min_linger = float(min_linger)
+        self.interval = float(interval)
+        self.shrink = float(shrink)
+        self.relax = float(relax)
+        self.family = family
+        self.adjustments = 0
+        self.observations = 0
+        self.last_p50_ms: float | None = None
+        self._next_due: float | None = None
+
+    def maybe_observe(self, digest_fn, now: float) -> bool:
+        """Cadence gate + digest pull; returns True when the lingers
+        changed (the engine then pushes them into its lane coalescers)."""
+        if self._next_due is not None and now < self._next_due:
+            return False
+        self._next_due = now + self.interval
+        try:
+            lat = digest_fn().get("latency_ms") or {}
+        except Exception:
+            return False  # tracer without metrics / digest fault: hold
+        p50 = (lat.get(self.family) or {}).get("p50")
+        if p50 is None:
+            return False  # no sampled commits yet: nothing to steer by
+        return self.observe(p50)
+
+    def observe(self, p50_ms: float) -> bool:
+        self.observations += 1
+        self.last_p50_ms = float(p50_ms)
+        old = (self.prio_linger, self.bulk_linger)
+        if p50_ms > self.slo_budget_ms:
+            # priority shrinks harder: it carries the SLO; bulk keeps
+            # more of its coalescing so throughput degrades gracefully
+            self.prio_linger = max(
+                self.min_linger, self.prio_linger * self.shrink
+            )
+            self.bulk_linger = max(
+                self.min_linger, self.bulk_linger * (self.shrink + 1.0) / 2.0
+            )
+        elif p50_ms < 0.5 * self.slo_budget_ms:
+            self.prio_linger = min(
+                self.prio_target, self.prio_linger * self.relax
+            )
+            self.bulk_linger = min(
+                self.bulk_target, self.bulk_linger * self.relax
+            )
+        changed = (self.prio_linger, self.bulk_linger) != old
+        if changed:
+            self.adjustments += 1
+        return changed
+
+    def stats(self) -> dict:
+        return {
+            "slo_budget_ms": self.slo_budget_ms,
+            "prio_linger_ms": round(self.prio_linger * 1e3, 4),
+            "bulk_linger_ms": round(self.bulk_linger * 1e3, 4),
+            "adjustments": self.adjustments,
+            "observations": self.observations,
+            "last_p50_ms": (
+                round(self.last_p50_ms, 3)
+                if self.last_p50_ms is not None else None
+            ),
+        }

@@ -41,9 +41,12 @@ from ..trace.tracer import (
     SPAN_COMMIT,
     SPAN_DEVICE,
     SPAN_LINGER,
+    SPAN_LINGER_BULK,
+    SPAN_LINGER_PRIO,
     SPAN_LOCK_WAIT,
     SPAN_PREP,
     SPAN_QUORUM,
+    SPAN_SPEC,
 )
 from ..types import TxVote, TxVoteSet
 from ..types.validator import ValidatorSet
@@ -75,10 +78,10 @@ class _StepPrep:
     __slots__ = (
         "keys", "votes", "slots", "n_slots", "prior", "msgs", "sigs",
         "val_idx", "dropped", "drain_seq", "verifier", "t0", "submit_t",
-        "trace_txs", "device_sid",
+        "trace_txs", "device_sid", "lane",
     )
 
-    def __init__(self, drain_seq: int, t0: float):
+    def __init__(self, drain_seq: int, t0: float, lane: str | None = None):
         self.keys: list[bytes] = []
         self.votes: list[TxVote] = []
         self.slots: list[int] = []
@@ -98,6 +101,10 @@ class _StepPrep:
         # stages it actually rode through
         self.trace_txs: list[str] = []
         self.device_sid = 0
+        # which drain lane produced this batch ("prio" / "bulk" / None =
+        # merged legacy drain): routes requeues back to the lane's own
+        # retry list so a priority repeat never queues behind bulk
+        self.lane = lane
 
 
 class _BatchCoalescer:
@@ -122,11 +129,12 @@ class _BatchCoalescer:
     __slots__ = (
         "targets", "linger", "full_batches", "linger_flushes",
         "_deadline", "_idle", "_clock", "_metrics", "_tracer", "_hold_t0",
+        "_span_name",
     )
 
     def __init__(self, buckets, cap: int, min_batch: int, linger: float,
                  metrics=None, clock=monotonic, tracer=None,
-                 multiple: int = 1):
+                 multiple: int = 1, span_name: str = SPAN_LINGER):
         # mesh divisibility: a sharded verifier pads every dispatch up to
         # a multiple of its shard count anyway (verifier.bucket_size), so
         # round the full-bucket targets here and drain exactly what the
@@ -148,6 +156,9 @@ class _BatchCoalescer:
         self._metrics = metrics
         self._tracer = tracer or NULL_TRACER
         self._hold_t0 = 0.0
+        # per-lane trace family (linger / linger_prio / linger_bulk):
+        # report.py attributes the hold to the lane that paid it
+        self._span_name = span_name
 
     def decide(self, pending: int) -> int:
         """Votes to dispatch NOW: a full canonical bucket, the whole
@@ -183,7 +194,7 @@ class _BatchCoalescer:
                 # batch-level hold: no single tx owns it, so the span is
                 # tagged with the empty tx (report.py attributes linger
                 # from the histogram sum, not per tx)
-                self._tracer.span("", SPAN_LINGER, self._hold_t0, now)
+                self._tracer.span("", self._span_name, self._hold_t0, now)
             return pending
         return 0
 
@@ -199,7 +210,13 @@ class _BatchCoalescer:
         time and idle detection happens on the idle_flush scale."""
         budget = poll
         if self._deadline is not None:
-            budget = min(budget, max(self._deadline - self._clock(), 0.0005))
+            rem = self._deadline - self._clock()
+            if rem <= 0:
+                # deadline already expired: the flush is due NOW — the
+                # old 0.5 ms floor here held every late linger flush for
+                # one extra poll past its deadline (ISSUE 12 small fix)
+                return 0.0
+            budget = min(budget, max(rem, 0.0005))
             if idle_flush > 0:
                 budget = min(budget, idle_flush)
         return budget
@@ -293,6 +310,22 @@ class TxFlow:
         # prepped twice.
         self._prio_drain_cursor = 0
         self._prio_drained: set[bytes] = set()
+        # lane-split drain (ISSUE 12): with a priority lane built in
+        # start(), the priority log and the bulk main-log walk
+        # (bulk_entries_from) become an exact partition and each lane
+        # keeps its own retry list — a priority in-batch repeat must
+        # requeue into the priority lane, never behind the bulk backlog
+        self._retry_prio: list[tuple[bytes, TxVote]] = []
+        self._prio_lane: _BatchCoalescer | None = None
+        self._linger_ctrl = None
+        self._lane_prio_batches = 0
+        self._lane_prio_votes = 0
+        # speculative quorum commit accounting (_route_result): commits
+        # routed early on the device quorum hint, and the route-tail
+        # seconds the early exit removed (sum over spec commits of
+        # route-end minus decision time)
+        self._spec_commits = 0
+        self._spec_saved_s = 0.0
         self._mtx = make_rlock("engine.TxFlow._mtx")
         self._running = False
         self._thread: threading.Thread | None = None
@@ -430,7 +463,37 @@ class TxFlow:
                     # full-bucket drains land exactly on the sharded
                     # verifier's rounded shapes (verifier.bucket_size)
                     multiple=self._verifier_shards(),
+                    span_name=SPAN_LINGER_BULK,
                 )
+        if self.config.lane_split and self._prio_lane is None:
+            # priority verify lane (ISSUE 12): small shard-divisible
+            # bucket targets capped at priority_bucket_cap, a short
+            # deadline (priority_linger), drained from the pool's
+            # priority log AHEAD of every bulk dispatch. Built even
+            # without a bucket ladder (scalar verifier — the _BatchCo-
+            # alescer degrades to cap-sized dispatches): the lane is
+            # about preemption, not shapes, and with no admission
+            # wiring the priority log is empty and decide(0) is free.
+            self._prio_lane = _BatchCoalescer(
+                self._verifier_buckets() or (),
+                cap=min(
+                    max(1, int(self.config.priority_bucket_cap)),
+                    self._drain_cap,
+                ),
+                min_batch=1,
+                linger=self.config.priority_linger,
+                tracer=self.tracer,
+                multiple=self._verifier_shards(),
+                span_name=SPAN_LINGER_PRIO,
+            )
+        if self.config.adaptive_linger and self._linger_ctrl is None:
+            from .adaptive import AdaptiveLingerController
+
+            self._linger_ctrl = AdaptiveLingerController(
+                slo_budget_ms=self.config.slo_budget_ms,
+                prio_linger=self.config.priority_linger,
+                bulk_linger=self.config.coalesce_linger,
+            )
         if int(self.config.host_prep_workers or 0) > 1 and self._host_pool is None:
             from .shapes import _unwrap_device
 
@@ -545,6 +608,61 @@ class TxFlow:
         else:
             self._run_serial()
 
+    def _prio_pending(self) -> int:
+        """Priority-lane backlog estimate: priority ingests not yet
+        walked plus the lane's own requeues (over-counts only removed-
+        not-yet-walked entries — the same safe coalescing estimate the
+        main log's seq gives)."""
+        return (
+            self.tx_vote_pool.prio_seq()
+            - self._prio_drain_cursor
+            + len(self._retry_prio)
+        )
+
+    def _bulk_pending(self) -> int:
+        """Bulk-lane backlog estimate. In lane-split mode the main-log
+        seq counts priority ingests too, so subtract the priority lane's
+        own backlog — both sides over-count dead entries, so the
+        difference stays a safe coalescing estimate that self-corrects
+        as the cursors advance."""
+        pending = self.tx_vote_pool.seq() - self._drain_cursor + len(self._retry)
+        if self._prio_lane is not None:
+            pending -= max(
+                self.tx_vote_pool.prio_seq() - self._prio_drain_cursor, 0
+            )
+        return max(pending, 0)
+
+    def _bulk_quantum(self) -> int:
+        """Bulk drain cap per step when the priority lane is on but no
+        bucket ladder exists (scalar verify): the verify of one bulk
+        batch is the priority lane's preemption gap — scalar verify has
+        no batch amortization (PR 6 soak finding), so a min_batch-sized
+        drain (256 default) is over a second of head-of-line blocking
+        for any priority vote that lands mid-verify on a 1-core box.
+        While priority traffic exists, drain bulk in small shard-rounded
+        quanta; a run that never saw a priority ingest keeps the full
+        min_batch drain — there is nothing to preempt, and more steps is
+        pure per-step overhead for the throughput benches."""
+        if self.tx_vote_pool.prio_seq() == 0:
+            return max(int(self.config.min_batch), 64)
+        m = max(1, self._verifier_shards())
+        return -(-64 // m) * m
+
+    def _steer_lingers(self) -> None:
+        """Adaptive per-lane linger (AdaptiveLingerController): feed the
+        live trace digest, push changed lingers into the lane
+        coalescers. Called once per collected batch; the controller
+        rate-limits its own digest pulls."""
+        ctrl = self._linger_ctrl
+        if ctrl is None or not self.tracer.active:
+            return
+        if ctrl.maybe_observe(self.tracer.digest, monotonic()):
+            if self._prio_lane is not None:
+                self._prio_lane.linger = ctrl.prio_linger
+            if self._coalescer is not None:
+                self._coalescer.linger = ctrl.bulk_linger
+            self.metrics.adaptive_linger_changes.add(1)
+
     def _run_serial(self) -> None:
         # Idle on the pool's per-vote sequence counter, NOT the once-per-
         # height txs_available event: when every pool vote is already in an
@@ -553,23 +671,46 @@ class TxFlow:
         # is sampled before step() so a vote arriving mid-step wakes us
         # immediately instead of being missed for a poll interval.
         co = self._coalescer
+        pl = self._prio_lane
+        lane_bulk = "bulk" if pl is not None else None
         while True:
             with self._mtx:
                 if not self._running:
                     return
             seq_before = self.tx_vote_pool.seq()
+            processed = 0
+            if pl is not None:
+                # priority lane first, always: a dispatchable priority
+                # batch (full small bucket or expired deadline) preempts
+                # any bulk work this iteration would start
+                plimit = pl.decide(self._prio_pending())
+                if plimit > 0:
+                    processed += self.step(limit=plimit, lane="prio")
             if co is not None:
                 # shape-stable sizing replaces min_batch/_form_batch: the
                 # coalescer hands out full canonical buckets (or a linger
                 # flush), and 0 means keep accumulating
-                pending = (
-                    self.tx_vote_pool.seq() - self._drain_cursor + len(self._retry)
-                )
-                limit = co.decide(pending)
-                processed = self.step(limit=limit) if limit > 0 else 0
+                limit = co.decide(self._bulk_pending())
+                if limit > 0:
+                    processed += self.step(limit=limit, lane=lane_bulk)
             else:
-                self._form_batch()
-                processed = self.step()
+                if pl is not None:
+                    # bound the forming hold by the priority lane's own
+                    # deadline so an armed priority linger fires on time,
+                    # and drain in quanta so a bulk verify never blocks
+                    # priority preemption for a whole backlog
+                    self._form_batch(
+                        budget=pl.wait_budget(
+                            self.config.batch_wait, self.config.idle_flush
+                        )
+                    )
+                    processed += self.step(
+                        limit=self._bulk_quantum(), lane=lane_bulk
+                    )
+                else:
+                    self._form_batch()
+                    processed += self.step()
+            self._steer_lingers()
             if self._committer is None and self._unapplied:
                 # no committer thread to run the deferred-apply retry
                 self._apply_unapplied()
@@ -577,9 +718,14 @@ class TxFlow:
                 budget = self.config.poll_interval
                 if co is not None:
                     budget = co.wait_budget(budget, self.config.idle_flush)
+                if pl is not None:
+                    budget = pl.wait_budget(budget, self.config.idle_flush)
                 got = self.tx_vote_pool.wait_for_new(seq_before, timeout=budget)
-                if co is not None and got == seq_before:
-                    co.note_idle()
+                if got == seq_before:
+                    if co is not None:
+                        co.note_idle()
+                    if pl is not None:
+                        pl.note_idle()
 
     def _run_pipelined(self) -> None:
         """Three-stage verify pipeline: host prep (stage 1) and commit
@@ -598,6 +744,8 @@ class TxFlow:
         inflight: deque[tuple[_StepPrep, object]] = deque()
         m = self.metrics
         co = self._coalescer
+        pl = self._prio_lane
+        lane_bulk = "bulk" if pl is not None else None
         ctrl = self._depth_ctrl
         try:
             while True:
@@ -619,28 +767,54 @@ class TxFlow:
                 # the bucket ladder replaces min_batch/_form_batch: only
                 # full canonical buckets (or linger flushes) dispatch.
                 while len(inflight) < depth:
+                    if pl is not None:
+                        # priority lane preempts every bulk dispatch this
+                        # fill would make: a dispatchable priority batch
+                        # (full small bucket or expired deadline) rides
+                        # the NEXT ticket, never behind a bulk backlog
+                        plimit = pl.decide(self._prio_pending())
+                        if plimit > 0:
+                            prep = self._prep_batch(limit=plimit, lane="prio")
+                            if prep is not None:
+                                if prep.votes:
+                                    inflight.append(
+                                        (prep, self._submit_prep(prep))
+                                    )
+                                    m.pipeline_depth.set(len(inflight))
+                                continue
+                            # estimate raced a purge (nothing drained):
+                            # fall through to the bulk lane this pass
                     if co is not None:
-                        pending = (
-                            self.tx_vote_pool.seq()
-                            - self._drain_cursor
-                            + len(self._retry)
-                        )
-                        limit = co.decide(pending)
+                        limit = co.decide(self._bulk_pending())
                         if limit <= 0:
                             break
-                        prep = self._prep_batch(limit=limit)
+                        prep = self._prep_batch(limit=limit, lane=lane_bulk)
                     else:
                         if not inflight:
-                            self._form_batch()
+                            if pl is not None:
+                                # bound the forming hold by the priority
+                                # lane's own deadline (see _run_serial)
+                                self._form_batch(
+                                    budget=pl.wait_budget(
+                                        self.config.batch_wait,
+                                        self.config.idle_flush,
+                                    )
+                                )
+                            else:
+                                self._form_batch()
                         else:
-                            pending = (
-                                self.tx_vote_pool.seq()
-                                - self._drain_cursor
-                                + len(self._retry)
-                            )
-                            if pending < max(1, self.config.min_batch):
+                            if self._bulk_pending() < max(
+                                1, self.config.min_batch
+                            ):
                                 break
-                        prep = self._prep_batch()
+                        prep = self._prep_batch(
+                            limit=(
+                                self._bulk_quantum()
+                                if pl is not None
+                                else None
+                            ),
+                            lane=lane_bulk,
+                        )
                     if prep is None:
                         break
                     if not prep.votes:
@@ -650,25 +824,32 @@ class TxFlow:
                 if not inflight:
                     if self._committer is None and self._unapplied:
                         self._apply_unapplied()
+                    if co is None and pl is None:
+                        if not self._retry:
+                            self.tx_vote_pool.wait_for_new(
+                                seq_before, timeout=self.config.poll_interval
+                            )
+                        continue
+                    budget = self.config.poll_interval
                     if co is not None:
-                        budget = co.wait_budget(
-                            self.config.poll_interval, self.config.idle_flush
-                        )
-                        got = self.tx_vote_pool.wait_for_new(
-                            seq_before, timeout=budget
-                        )
-                        if got == seq_before:
+                        budget = co.wait_budget(budget, self.config.idle_flush)
+                    if pl is not None:
+                        budget = pl.wait_budget(budget, self.config.idle_flush)
+                    got = self.tx_vote_pool.wait_for_new(
+                        seq_before, timeout=budget
+                    )
+                    if got == seq_before:
+                        if co is not None:
                             co.note_idle()
-                    elif not self._retry:
-                        self.tx_vote_pool.wait_for_new(
-                            seq_before, timeout=self.config.poll_interval
-                        )
+                        if pl is not None:
+                            pl.note_idle()
                     continue
                 prep, ticket = inflight.popleft()
                 m.pipeline_depth.set(len(inflight))
                 result = self._collect(prep, ticket)
                 decided, requeued, all_deferred = self._route_result(prep, result)
                 self._pipe_steps += 1
+                self._steer_lingers()
                 if ctrl is not None:
                     new_depth = ctrl.observe(
                         self._pipe_busy_s, self._pipe_active_s, self._pipe_steps
@@ -706,16 +887,22 @@ class TxFlow:
                     traceback.print_exc()
             m.pipeline_depth.set(0)
 
-    def _form_batch(self) -> None:
+    def _form_batch(self, budget: float | None = None) -> None:
         """Hold up to batch_wait for min_batch pending votes to coalesce.
 
         Bounded added latency (batch_wait) in exchange for device-sized
         batches: one kernel call per thousands of votes instead of one per
-        gossip arrival (SURVEY §7 hard-part 5)."""
+        gossip arrival (SURVEY §7 hard-part 5). ``budget`` caps the hold
+        below batch_wait — the lane-split loops pass the priority lane's
+        wait_budget so an armed priority deadline fires on time instead
+        of waiting out a full bulk forming window."""
         min_batch = self.config.min_batch
         if min_batch <= 1:
             return
-        deadline = monotonic() + self.config.batch_wait
+        wait = self.config.batch_wait
+        if budget is not None:
+            wait = min(wait, max(budget, 0.0))
+        deadline = monotonic() + wait
         idle_flush = self.config.idle_flush
         while True:
             # unvisited ingest ≈ seq (log end) minus the drain cursor:
@@ -740,7 +927,7 @@ class TxFlow:
 
     # ---- batched aggregation step ----
 
-    def step(self, limit: int | None = None) -> int:
+    def step(self, limit: int | None = None, lane: str | None = None) -> int:
         """One serial verify+tally+commit round (prep -> submit -> collect
         -> route, no overlap); returns votes PROCESSED this step: votes
         routed to a decision (added / rejected / late) plus votes dropped
@@ -752,8 +939,10 @@ class TxFlow:
         decided + requeued always reconciles to the verified batch size.
         ``limit`` caps the batch (retries + fresh drain) below the drain
         cap — the coalescer passes a canonical bucket size here.
+        ``lane`` selects the drain source ("prio" / "bulk" / None =
+        merged legacy drain — see _prep_batch).
         """
-        prep = self._prep_batch(limit=limit)
+        prep = self._prep_batch(limit=limit, lane=lane)
         if prep is None:
             return 0
         if not prep.votes:
@@ -786,14 +975,25 @@ class TxFlow:
             )
         return decided + prep.dropped
 
-    def _prep_batch(self, limit: int | None = None) -> "_StepPrep | None":
+    def _prep_batch(
+        self, limit: int | None = None, lane: str | None = None
+    ) -> "_StepPrep | None":
         """Stage 1: drain the pool, dedup against committed/held votes,
         assign tx slots, gather prior stake, and build sign bytes — all
         host work, under _mtx. Returns None when nothing was drained; a
         prep with empty ``votes`` when everything drained was dropped.
         ``limit`` is the total batch target (retries included) — the
         coalescer passes a canonical bucket size so the dispatched batch
-        lands exactly on a prewarmed shape."""
+        lands exactly on a prewarmed shape.
+
+        ``lane`` selects the drain source (ISSUE 12 lane split):
+        "prio" walks ONLY the pool's priority log (+ the lane's own
+        retries), "bulk" walks the main log skipping ingest-frozen
+        priority entries (bulk_entries_from) — together an exact
+        partition, so neither lane needs the merged path's
+        _prio_drained dedup set. None keeps the legacy merged drain
+        (priority log ahead of the main-log walk, dedup via
+        _prio_drained) for direct step() callers and lane_split=False."""
         t0 = monotonic()
         target = self._drain_cap if limit is None else min(limit, self._drain_cap)
         # seq snapshot BEFORE the drain: the defer-backoff wait must wake
@@ -807,38 +1007,58 @@ class TxFlow:
             # it from the host component
             lk_acq = monotonic()
             self._pipe_lock_wait_s += lk_acq - t0
-            # priority-lane votes first: under overload the main log can
-            # be thousands of bulk votes deep, and a priority tx's quorum
-            # must not wait out that backlog (admission lanes, ISSUE 6)
-            praw, self._prio_drain_cursor = self.tx_vote_pool.priority_entries_from(
-                self._prio_drain_cursor,
-                limit=max(target - len(self._retry), 0),
-            )
-            drained = self._prio_drained
-            drained.update(k for k, _v, _h, _s in praw)
-            raw, self._drain_cursor = self.tx_vote_pool.entries_from(
-                self._drain_cursor,
-                limit=max(target - len(self._retry) - len(praw), 0),
-            )
-            fresh: list[tuple[bytes, TxVote]] = []
-            for k, v, _h, _s in raw:
-                if k in drained:
-                    drained.discard(k)  # main log reached it: done tracking
-                    continue
-                fresh.append((k, v))
-            if len(drained) > 8192:
-                # keys whose main-log entry was compacted away before the
-                # cursor reached them (committed early) would accumulate;
-                # keep only keys the pool still holds
-                has = self.tx_vote_pool.has
-                self._prio_drained = {k for k in drained if has(k)}
-            batch = (
-                self._retry + [(k, v) for k, v, _h, _s in praw] + fresh
-            )
-            self._retry = []
+            if lane == "prio":
+                praw, self._prio_drain_cursor = (
+                    self.tx_vote_pool.priority_entries_from(
+                        self._prio_drain_cursor,
+                        limit=max(target - len(self._retry_prio), 0),
+                    )
+                )
+                batch = self._retry_prio + [(k, v) for k, v, _h, _s in praw]
+                self._retry_prio = []
+            elif lane == "bulk":
+                raw, self._drain_cursor = self.tx_vote_pool.bulk_entries_from(
+                    self._drain_cursor,
+                    limit=max(target - len(self._retry), 0),
+                )
+                batch = self._retry + [(k, v) for k, v, _h, _s in raw]
+                self._retry = []
+            else:
+                # priority-lane votes first: under overload the main log
+                # can be thousands of bulk votes deep, and a priority tx's
+                # quorum must not wait out that backlog (admission lanes,
+                # ISSUE 6)
+                praw, self._prio_drain_cursor = (
+                    self.tx_vote_pool.priority_entries_from(
+                        self._prio_drain_cursor,
+                        limit=max(target - len(self._retry), 0),
+                    )
+                )
+                drained = self._prio_drained
+                drained.update(k for k, _v, _h, _s in praw)
+                raw, self._drain_cursor = self.tx_vote_pool.entries_from(
+                    self._drain_cursor,
+                    limit=max(target - len(self._retry) - len(praw), 0),
+                )
+                fresh: list[tuple[bytes, TxVote]] = []
+                for k, v, _h, _s in raw:
+                    if k in drained:
+                        drained.discard(k)  # main log reached it: done
+                        continue
+                    fresh.append((k, v))
+                if len(drained) > 8192:
+                    # keys whose main-log entry was compacted away before
+                    # the cursor reached them (committed early) would
+                    # accumulate; keep only keys the pool still holds
+                    has = self.tx_vote_pool.has
+                    self._prio_drained = {k for k in drained if has(k)}
+                batch = (
+                    self._retry + [(k, v) for k, v, _h, _s in praw] + fresh
+                )
+                self._retry = []
             if not batch:
                 return None
-            prep = _StepPrep(drain_seq, t0)
+            prep = _StepPrep(drain_seq, t0, lane=lane)
             keys, votes, slots = prep.keys, prep.votes, prep.slots
             slot_of: dict[str, int] = {}
             drop_now: list[bytes] = []
@@ -863,8 +1083,13 @@ class TxFlow:
                     and len(slot_of) >= self.config.max_slots
                 ):
                     # leave the tail for the next step (the cursor has
-                    # already passed it, so it re-queues explicitly)
-                    self._retry.extend(batch[bi:])
+                    # already passed it, so it re-queues explicitly) — in
+                    # the lane's OWN retry list: a priority tail must
+                    # never re-enter behind the bulk backlog
+                    if lane == "prio":
+                        self._retry_prio.extend(batch[bi:])
+                    else:
+                        self._retry.extend(batch[bi:])
                     break
                 slot = slot_of.setdefault(vote.tx_hash, len(slot_of))
                 keys.append(key)
@@ -959,6 +1184,11 @@ class TxFlow:
         device and never come back."""
         t0 = monotonic()
         prep.submit_t = t0
+        if prep.lane == "prio":
+            self._lane_prio_batches += 1
+            self._lane_prio_votes += len(prep.votes)
+            self.metrics.lane_prio_batches.add(1)
+            self.metrics.lane_prio_votes.add(len(prep.votes))
         gate = self._warm_gate
         if (
             gate is not None
@@ -1035,6 +1265,12 @@ class TxFlow:
         # inline-commit decisions made under _mtx; their store/ABCI
         # side-effects run AFTER the lock is released (see below)
         inline_commits: list[tuple[TxVoteSet, list[TxVote], bytes | None]] = []
+        # speculative quorum commit (ISSUE 12): decision timestamps of
+        # commits routed on the device's maj23 hint, and their open
+        # spec_commit span ids (finished at route end — the tail the
+        # early exit removed)
+        spec_t: list[float] = []
+        spec_sids: list[int] = []
         with self._mtx:
             self.metrics.batch_size.observe(len(votes))
             self.metrics.verified_votes.add(int(result.valid.sum()))
@@ -1046,15 +1282,48 @@ class TxFlow:
             # with same-batch late votes
             bad_keys: list[bytes] = []
             purge_votes: list[TxVote] = []  # quorum votes, ONE pool purge/step
+            # a requeue re-enters through the lane that drained it — a
+            # priority repeat must never wait out the bulk backlog
+            retry_lane = (
+                self._retry_prio if prep.lane == "prio" else self._retry
+            )
             # per-element numpy bool indexing costs ~100 ns each at batch
             # scale — lists are ~5x cheaper in this Python loop
             valid_l = result.valid.tolist()
             dropped_l = result.dropped.tolist()
-            for i, vote in enumerate(votes):
+            n = len(votes)
+            # speculative quorum commit: the ticket's readback carries a
+            # per-slot maj23 hint (prior stake + this batch's tally over
+            # the 2n/3 line). Route the hinted slots' votes FIRST so
+            # their commit decisions — and the committer's store/apply
+            # effects behind them — start the instant the readback lands
+            # instead of after the whole drain routes. The hint is only a
+            # ROUTING-ORDER hint: in pipelined mode the prior snapshot
+            # can be a batch stale either way, so the host TxVoteSet
+            # below still decides every quorum. All votes of one tx share
+            # one slot, so the partition reorders only ACROSS txs (both
+            # halves keep ascending batch order within themselves):
+            # certificates stay byte-identical to the scalar golden path,
+            # only cross-tx commit order may shift — which is why
+            # speculative_commit defaults off (utils/config.py).
+            order = None
+            spec_n = 0
+            if self.config.speculative_commit:
+                maj_l = result.maj23.tolist()
+                slots_l = prep.slots
+                first = [i for i in range(n) if maj_l[slots_l[i]]]
+                if first and len(first) < n:
+                    order = first + [
+                        i for i in range(n) if not maj_l[slots_l[i]]
+                    ]
+                    spec_n = len(first)
+            for pos in range(n):
+                i = order[pos] if order is not None else pos
+                vote = votes[i]
                 if dropped_l[i]:
                     # in-batch (slot, validator) repeat: the cursor has
                     # passed this entry, so re-queue it for the next step
-                    self._retry.append((keys[i], vote))
+                    retry_lane.append((keys[i], vote))
                     requeued += 1
                     continue
                 if not valid_l[i]:
@@ -1073,10 +1342,21 @@ class TxFlow:
                 added, err = vs.add_verified_vote(vote)
                 if added:
                     if vs.has_two_thirds_majority():
-                        if tr.active and tr.sampled(vote.tx_hash):
-                            # routing latency up to THIS decision: result
-                            # available (route start) -> quorum latched
-                            tr.span(vote.tx_hash, SPAN_QUORUM, t0, monotonic())
+                        in_spec = pos < spec_n
+                        traced = tr.active and tr.sampled(vote.tx_hash)
+                        if in_spec or traced:
+                            now = monotonic()
+                            if traced:
+                                # routing latency up to THIS decision:
+                                # result available (route start) ->
+                                # quorum latched
+                                tr.span(vote.tx_hash, SPAN_QUORUM, t0, now)
+                            if in_spec:
+                                spec_t.append(now)
+                                if traced:
+                                    spec_sids.append(
+                                        tr.begin(vote.tx_hash, SPAN_SPEC, now)
+                                    )
                         if self._committer is not None:
                             self._enqueue_commit(vs)
                         else:
@@ -1103,6 +1383,20 @@ class TxFlow:
             self.tx_vote_pool.update(self.height, purge_votes)
 
         t1 = monotonic()
+        if spec_t:
+            # saved tail per spec commit: route end minus its decision
+            # time — the wait the early exit removed from its latency
+            self._spec_commits += len(spec_t)
+            saved = 0.0
+            for t in spec_t:
+                saved += t1 - t
+            self._spec_saved_s += saved
+            self.metrics.spec_commits.add(len(spec_t))
+            self.metrics.spec_saved_seconds.add(saved)
+        for sid in spec_sids:
+            # always closed here — the drain-on-stop invariant (zero open
+            # spec_commit spans) rides the same finally-drain as device
+            tr.finish(sid, t1)
         self._pipe_route_s += t1 - t0
         self._pipe_active_s += t1 - t0
         self.metrics.pipeline_route_seconds.add(t1 - t0)
@@ -1153,6 +1447,28 @@ class TxFlow:
             "linger_flushes": co.linger_flushes if co is not None else 0,
             "cold_fallback_votes": self._cold_fallback_votes,
         }
+        pl = self._prio_lane
+        stats["lanes"] = {
+            "enabled": pl is not None,
+            "prio_batches": self._lane_prio_batches,
+            "prio_votes": self._lane_prio_votes,
+            "prio_full_batches": pl.full_batches if pl is not None else 0,
+            "prio_linger_flushes": pl.linger_flushes if pl is not None else 0,
+            # live lingers (adaptive_linger steers these at runtime)
+            "prio_linger_ms": (
+                round(pl.linger * 1e3, 4) if pl is not None else None
+            ),
+            "bulk_linger_ms": (
+                round(co.linger * 1e3, 4) if co is not None else None
+            ),
+        }
+        stats["spec"] = {
+            "enabled": bool(self.config.speculative_commit),
+            "commits": self._spec_commits,
+            "saved_s": round(self._spec_saved_s, 4),
+        }
+        if self._linger_ctrl is not None:
+            stats["adaptive_linger"] = self._linger_ctrl.stats()
         gate = self._warm_gate
         if gate is not None:
             warm = len(gate.warmed)

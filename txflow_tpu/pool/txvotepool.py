@@ -62,6 +62,13 @@ class _PoolVote:
     # batch frame is a plain b"".join of these, so per-peer broadcast
     # walks never re-serialize (r4 profile: lp+append per vote per peer)
     seg: bytes = b""
+    # ingest-time admission lane (LANE_PRIORITY or -1): the partition
+    # key for the engine's lane-split drain. Frozen at ingest so the
+    # priority log and bulk_entries_from stay an exact partition of the
+    # main log even if the lane hook's answer drifts later (mempool
+    # eviction, late tx arrival) — a vote is delivered by EXACTLY the
+    # log its ingest classified it into. Set by BOTH ingest twins.
+    lane: int = -1
 
 
 class TxVotePool(IngestLogPool):
@@ -322,6 +329,7 @@ class TxVotePool(IngestLogPool):
                     entry.senders = {sid}
                     entry.size = vote_size
                     entry.seg = seg
+                    entry.lane = lane
                     votes_d[key] = entry
                     by_tx = self._by_tx.get(vote.tx_hash)
                     if by_tx is None:
@@ -388,7 +396,8 @@ class TxVotePool(IngestLogPool):
             seg = amino.length_prefixed(encoded)
             object.__setattr__(vote, "_seg_cache", seg)
         entry = _PoolVote(
-            self.height, vote, {tx_info.sender_id}, vote_size, seg=seg
+            self.height, vote, {tx_info.sender_id}, vote_size, seg=seg,
+            lane=lane,
         )
         self._votes[key] = entry
         by_tx = self._by_tx.get(vote.tx_hash)
@@ -444,6 +453,35 @@ class TxVotePool(IngestLogPool):
         tuples; see IngestLogPool._entries_from for the cursor contract."""
         raw, pos = self._entries_from(cursor, limit)
         return [(k, e.vote, e.height, e.seg) for k, e in raw], pos
+
+    def prio_seq(self) -> int:
+        """Monotonic priority-ingest counter (seq()'s twin for the
+        priority log): prio_seq - cursor over-counts only by removed-
+        not-yet-walked entries, the same safe pending estimate the main
+        log's seq gives the engine's coalescer."""
+        with self._mtx:
+            return self._prio_log_base + len(self._prio_log)
+
+    def bulk_entries_from(
+        self, cursor: int, limit: int = 256
+    ) -> tuple[list[tuple[bytes, TxVote, int, bytes]], int]:
+        """entries_from over bulk-lane votes only: the main-log walk,
+        skipping entries whose INGEST-time lane was priority — those are
+        the priority log's to deliver (priority_entries_from), so the
+        two walks form an exact partition of the pool and the engine's
+        lane-split drain visits every vote exactly once. The cursor
+        still advances over skipped/dead entries (stable-cursor
+        contract, IngestLogPool)."""
+        out: list[tuple[bytes, TxVote, int, bytes]] = []
+        with self._mtx:
+            pos = max(cursor, self._log_base)
+            while pos - self._log_base < len(self._log) and len(out) < limit:
+                key = self._log[pos - self._log_base]
+                e = self._votes.get(key)
+                if e is not None and e.lane != LANE_PRIORITY:
+                    out.append((key, e.vote, e.height, e.seg))
+                pos += 1
+        return out, pos
 
     def priority_entries_from(
         self, cursor: int, limit: int = 256

@@ -17,9 +17,15 @@ def critical_path(pipeline_stats: dict, trace_digest: dict | None = None) -> dic
     Components: ``host_s`` (batch prep + commit routing, minus lock
     wait), ``device_s`` (blocked collecting verify tickets), ``lock_-
     wait_s`` (acquiring the engine mutex), ``linger_s`` (coalescer
-    deadline holds, from the trace histogram sum), ``network_residual_-
-    ms`` (e2e p50 minus the sum of in-node stage p50s: gossip transit +
-    queueing the in-node stages can't see)."""
+    deadline holds, from the trace histogram sums — merged + per-lane
+    families; the priority/bulk split is exposed alongside as
+    ``linger_prio_s`` / ``linger_bulk_s`` so a lane-split run shows
+    WHICH lane paid the hold), ``network_residual_ms`` (e2e p50 minus
+    the sum of in-node stage p50s: gossip transit + queueing the
+    in-node stages can't see). A speculative-commit run also reports
+    ``spec_saved_s`` — the route-tail seconds the early quorum exit
+    removed (engine ``spec`` stats) — as attribution context, not a
+    busy component (it is time NOT spent)."""
     stats = pipeline_stats or {}
     lat = (trace_digest or {}).get("latency_ms") or {}
 
@@ -30,14 +36,25 @@ def critical_path(pipeline_stats: dict, trace_digest: dict | None = None) -> dic
     prep = stats.get("prep_s", 0.0)
     route = stats.get("route_s", 0.0)
     host = max(0.0, prep - lock_wait) + route
+    linger_prio = sum_s("linger_prio")
+    linger_bulk = sum_s("linger_bulk")
     parts = {
         "host_s": host,
         "device_s": stats.get("dispatch_wait_s", 0.0),
         "lock_wait_s": lock_wait,
-        "linger_s": sum_s("linger"),
+        # legacy merged family + the per-lane families: a pre-lane trace
+        # has only "linger", a lane-split run only the per-lane ones
+        "linger_s": sum_s("linger") + linger_prio + linger_bulk,
     }
     busy = sum(parts.values())
     out = {k: round(v, 4) for k, v in parts.items()}
+    if linger_prio > 0.0 or linger_bulk > 0.0:
+        out["linger_prio_s"] = round(linger_prio, 4)
+        out["linger_bulk_s"] = round(linger_bulk, 4)
+    spec = stats.get("spec") or {}
+    if spec.get("commits"):
+        out["spec_saved_s"] = round(spec.get("saved_s", 0.0), 4)
+        out["spec_commits"] = int(spec["commits"])
     # When a host-prep pool ran, split the host bucket into the serial
     # remainder vs time spent waiting on pool shards (pool wait is wall
     # time the caller could NOT overlap — the lever sharded host prep
@@ -57,7 +74,8 @@ def critical_path(pipeline_stats: dict, trace_digest: dict | None = None) -> dic
         stage_sum = sum(
             lat.get(n, {}).get("p50") or 0.0
             for n in ("vote_ingest", "host_prep", "device_verify",
-                      "quorum_latch", "commit_apply", "linger")
+                      "quorum_latch", "commit_apply", "linger",
+                      "linger_prio", "linger_bulk")
         )
         out["network_residual_ms"] = round(max(0.0, e2e - stage_sum), 3)
     return out
@@ -68,10 +86,15 @@ def merge_critical_paths(per_node: list[dict]) -> dict:
     the fleet-level line bench.py emits."""
     keys = ("host_s", "device_s", "lock_wait_s", "linger_s")
     total = {k: round(sum(cp.get(k, 0.0) for cp in per_node), 4) for k in keys}
-    for k in ("prep_serial_s", "prep_pool_wait_s"):
+    for k in ("prep_serial_s", "prep_pool_wait_s", "linger_prio_s",
+              "linger_bulk_s", "spec_saved_s"):
         if any(k in cp for cp in per_node):
             total[k] = round(sum(cp.get(k, 0.0) for cp in per_node), 4)
-    busy = sum(total.values())
+    if any("spec_commits" in cp for cp in per_node):
+        total["spec_commits"] = sum(
+            cp.get("spec_commits", 0) for cp in per_node
+        )
+    busy = sum(total[k] for k in keys)
     if busy > 0:
         total["fractions"] = {
             k.removesuffix("_s"): round(v / busy, 4) for k, v in total.items()
@@ -97,10 +120,20 @@ def format_line(cp: dict) -> str:
         for k in ("host_s", "device_s", "lock_wait_s", "linger_s")
     )
     line = f"critical-path: {parts} bound={cp.get('bound', 'n/a')}"
+    if "linger_prio_s" in cp or "linger_bulk_s" in cp:
+        line += (
+            f" linger[prio={cp.get('linger_prio_s', 0.0):.3f}s"
+            f" bulk={cp.get('linger_bulk_s', 0.0):.3f}s]"
+        )
     if "prep_pool_wait_s" in cp:
         line += (
             f" host[prep_serial={cp.get('prep_serial_s', 0.0):.3f}s"
             f" prep_pool_wait={cp['prep_pool_wait_s']:.3f}s]"
+        )
+    if cp.get("spec_saved_s") is not None:
+        line += (
+            f" spec_saved={cp['spec_saved_s']:.3f}s"
+            f"({cp.get('spec_commits', 0)})"
         )
     if cp.get("network_residual_ms") is not None:
         line += f" net_residual={cp['network_residual_ms']:.1f}ms"

@@ -41,6 +41,16 @@ SPAN_SIGN = "sign_walk"
 SPAN_VOTE_INGEST = "vote_ingest"
 SPAN_LOCK_WAIT = "lock_wait"
 SPAN_LINGER = "linger"
+# per-lane coalescer holds (ISSUE 12 verify lanes): the engine's bulk
+# lane records linger_bulk, the priority lane linger_prio; the plain
+# "linger" family remains for single-lane coalescers and old dumps —
+# report.py sums all three into the critical-path linger bucket
+SPAN_LINGER_PRIO = "linger_prio"
+SPAN_LINGER_BULK = "linger_bulk"
+# speculative quorum commit: decision-to-route-end window of a commit
+# that left early on the device quorum hint — its length IS the route
+# tail the early exit removed for that tx
+SPAN_SPEC = "spec_commit"
 SPAN_PREP = "host_prep"
 SPAN_DEVICE = "device_verify"
 SPAN_QUORUM = "quorum_latch"
@@ -54,9 +64,10 @@ SPAN_E2E = "e2e"
 
 SPAN_ORDER = (
     SPAN_ADMISSION, SPAN_TX_INGEST, SPAN_GOSSIP_INGEST, SPAN_SIGN,
-    SPAN_VOTE_INGEST, SPAN_LOCK_WAIT, SPAN_LINGER, SPAN_PREP,
-    SPAN_DEVICE, SPAN_QUORUM, SPAN_COMMIT, SPAN_SYNC_FETCH,
-    SPAN_SYNC_VERIFY, SPAN_SYNC_APPLY, SPAN_E2E,
+    SPAN_VOTE_INGEST, SPAN_LOCK_WAIT, SPAN_LINGER, SPAN_LINGER_PRIO,
+    SPAN_LINGER_BULK, SPAN_PREP, SPAN_DEVICE, SPAN_QUORUM, SPAN_SPEC,
+    SPAN_COMMIT, SPAN_SYNC_FETCH, SPAN_SYNC_VERIFY, SPAN_SYNC_APPLY,
+    SPAN_E2E,
 )
 
 

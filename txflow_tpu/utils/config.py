@@ -179,6 +179,39 @@ class EngineConfig:
     # across workers is real parallelism even on GIL builds. 0 = serial
     # prep on the engine thread (reference behavior).
     host_prep_workers: int = 0
+    # deadline-aware verify lanes (engine.txflow): split the drain into
+    # a PRIORITY lane — the pool's priority ingest log (admission fee
+    # lanes), dispatched in small short-linger batches AHEAD of the bulk
+    # backlog — and a BULK lane keeping today's throughput linger. With
+    # no admission wiring the priority log stays empty and the lane
+    # costs one decide(0) per fill pass.
+    lane_split: bool = True
+    # priority-lane linger: how long a partial priority batch may
+    # coalesce before flushing (the deadline the lane exists to honor);
+    # the bulk lane keeps coalesce_linger
+    priority_linger: float = 0.001
+    # largest priority-lane dispatch: bucket-ladder rungs at or under
+    # this (rounded up to the mesh shard multiple, PR 10) are the lane's
+    # full-batch targets; with no ladder (scalar verifier) the lane
+    # dispatches at this cap
+    priority_bucket_cap: int = 512
+    # adaptive per-lane linger (engine.adaptive.AdaptiveLingerController):
+    # steer both lane lingers from the live trace digest against
+    # slo_budget_ms. Off by default — it needs an active tracer and
+    # windows of traffic to say anything; bench.py --latency-slo opts in.
+    adaptive_linger: bool = False
+    slo_budget_ms: float = 50.0
+    # speculative quorum commit (engine.txflow._route_result): at collect
+    # time, route votes whose slot's device tally readback already shows
+    # 2n/3 stake FIRST, so their commits leave for the committer before
+    # the rest of the batch routes. The host TxVoteSet still decides
+    # every quorum (the device bit is only a routing-ORDER hint, it may
+    # be stale in either direction under pipelining) — certificates stay
+    # byte-identical to the scalar golden path. Off by default: the
+    # early exit reorders commits ACROSS txs within a batch, and the
+    # serial-vs-pipelined golden tests pin strict commit order; the
+    # latency bench and latency-sensitive deployments opt in.
+    speculative_commit: bool = False
 
 
 @dataclass

@@ -438,6 +438,14 @@ class TxFlowMetrics:
         # adaptive pipeline depth (engine.adaptive.AdaptiveDepthController)
         self.pipeline_depth_target = r.gauge("txflow", "pipeline_depth_target", "adaptive controller's current depth target")
         self.pipeline_depth_changes = r.counter("txflow", "pipeline_depth_changes", "adaptive depth adjustments applied")
+        # deadline-aware verify lanes (ISSUE 12): priority-lane dispatch
+        # volume, speculative quorum commits and the route-tail seconds
+        # the early exit removed, adaptive per-lane linger adjustments
+        self.lane_prio_batches = r.counter("lanes", "prio_batches", "verify batches dispatched through the priority lane")
+        self.lane_prio_votes = r.counter("lanes", "prio_votes", "votes dispatched through the priority lane")
+        self.spec_commits = r.counter("txflow", "spec_commits", "commits routed early on the device quorum hint")
+        self.spec_saved_seconds = r.counter("txflow", "spec_saved_seconds", "route-tail seconds removed by speculative commits")
+        self.adaptive_linger_changes = r.counter("txflow", "adaptive_linger_changes", "adaptive lane-linger adjustments applied")
         # engine-side epoch churn (TxFlow.update_state): a rotation is one
         # validator-set swap observed by this engine; restages swap device
         # constants in place (zero recompiles), rebuilds construct a fresh
