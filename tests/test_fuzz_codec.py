@@ -11,7 +11,12 @@ import conftest  # noqa: F401
 
 import hashlib
 
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# optional dependency: environments without hypothesis skip the fuzz
+# suite instead of failing collection
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from txflow_tpu import native
 from txflow_tpu.codec import amino

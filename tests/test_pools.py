@@ -330,6 +330,41 @@ def test_votepool_check_tx_many_parity():
         assert not a.has(vote_key(v)) and not b.has(vote_key(v))
 
 
+def test_votepool_origin_parity():
+    """Ingest-origin stamping through both twins: the sender id frozen on
+    an entry at ingest (what invalid-verdict attribution charges) must be
+    identical whether the vote arrived via check_tx or check_tx_many, a
+    later add_sender must never rewrite it, and a local/unattributed
+    ingest must read back as UNKNOWN_PEER_ID (drift alarm for the
+    accountable-gossip origin branch of the twins)."""
+    from txflow_tpu.pool.txvotepool import UNKNOWN_PEER_ID
+
+    pv = MockPV()
+    v0, v1, v2 = (make_vote(i, pv) for i in range(3))
+
+    def mk():
+        return TxVotePool(MempoolConfig(size=10, cache_size=100))
+
+    a, b = mk(), mk()
+    a.check_tx(v0, tx_info=TxInfo(sender_id=5))
+    a.check_tx(v1, tx_info=TxInfo(sender_id=7))
+    a.check_tx(v2)  # local: no TxInfo
+    b.check_tx_many([v0, v1], tx_info=TxInfo(sender_id=5))
+    b.check_tx_many([v2])
+    keys = [vote_key(v) for v in (v0, v1, v2)]
+    assert a.origins_of(keys) == [5, 7, UNKNOWN_PEER_ID]
+    assert b.origins_of(keys) == [5, 5, UNKNOWN_PEER_ID]
+    # origin is frozen at ingest: extra senders accumulate, attribution
+    # stays with the first relayer
+    for p in (a, b):
+        p.add_sender(keys[0], 9)
+        assert p.origins_of(keys[:1]) == [p.origins_of(keys[:1])[0]]
+    assert a.origins_of(keys[:1]) == [5]
+    assert b.origins_of(keys[:1]) == [5]
+    # unknown keys attribute to nobody
+    assert a.origins_of([b"\x00" * 32]) == [UNKNOWN_PEER_ID]
+
+
 def test_votepool_lane_eviction_parity():
     """Lane-aware ingest through both twins: priority votes land on the
     priority log, and at pool-full a priority vote evicts the oldest

@@ -653,3 +653,33 @@ def test_warmup_full_compiles_every_reachable_shape(valset4):
     dev2.warmup(256)
     want = {b for b in dev2.miss_buckets if b <= 256}
     assert set(seen2) >= want, (seen2, want)
+
+
+def test_replay_flood_costs_zero_repeat_dispatches(valset4):
+    """Replay-flood regression (accountable gossip): re-submitting a
+    batch the verifier has already judged must cost ZERO device
+    dispatches — the verdict cache replays every verdict, including the
+    False ones, so an identical-vote flood can never re-buy device time
+    with signatures that already failed."""
+    from txflow_tpu.verifier import VerifyCache
+
+    vals, seeds = valset4
+    dev = DeviceVoteVerifier(vals, shared_cache=VerifyCache())
+    dispatches: list[int] = []
+    orig = dev._dispatch_verify_only
+
+    def spy(msgs, sigs, val_idx, **kw):
+        dispatches.append(len(msgs))
+        return orig(msgs, sigs, val_idx, **kw)
+
+    dev._dispatch_verify_only = spy
+    msgs, sigs, vidx, slot = make_batch(vals, seeds, n_txs=3, corrupt=("ok", "flip"))
+    r1 = dev.verify_and_tally(msgs, sigs, vidx, slot, 3)
+    assert len(dispatches) == 1 and dispatches[0] == len(msgs)
+    assert r1.valid.any() and not r1.valid.all()  # mixed verdicts cached
+
+    r2 = dev.verify_and_tally(msgs, sigs, vidx, slot, 3)
+    assert len(dispatches) == 1, "an identical replay must not reach the device"
+    np.testing.assert_array_equal(r1.valid, r2.valid)
+    np.testing.assert_array_equal(r1.stake, r2.stake)
+    np.testing.assert_array_equal(r1.maj23, r2.maj23)

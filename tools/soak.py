@@ -7,7 +7,7 @@ ticker, hostile votes (bad sig, unknown validator, oversized fields),
 repeated partitions and heals — then checks for forks, stalls, and leaks.
 Usage: JAX_PLATFORMS=cpu python tools/soak.py [seconds] [--rotate] [--restart]
                                               [--smoke] [--overload]
-                                              [--wan-matrix]
+                                              [--wan-matrix] [--byzantine]
 --restart periodically stops one durable node, rebuilds it over its
 artifacts (fresh app, handshake replay + catchup), and reconnects it —
 the restart x partition x load interleaving that exposed the r5
@@ -30,6 +30,17 @@ of the run (merged Chrome-trace JSON, SOAK_TRACE_OUT to choose the
 path) and asserts ZERO leaked/unclosed trace spans post-quiescence via
 each node's /health trace digest. Exits 1 with a SOAK STALL banner on
 any breach; --overload --smoke is tier-1-budget sized.
+--byzantine: the ISSUE-14 accountable-gossip soak — a 4-node LocalNet
+with one validator turned Byzantine (fast-path signer disarmed, its
+switch flooding garbage-signature / stale / forged-address votes) plus
+a malicious non-validator peer (unknown-signer floods + identical-vote
+replays), breakers armed at production-shaped thresholds from t=0,
+under continuous honest load. Asserts zero admitted-tx loss, every
+adversary struck AND quarantined on every honest node, the front-door
+gate absorbing the still-running flood (quarantined drops growing),
+and a post-quarantine waste bound: < 5% of subsequently device-
+dispatched votes invalid. Exits 1 with a SOAK STALL banner on any
+breach; --byzantine --smoke is CI-sized.
 --wan-matrix: the ISSUE-11 network-weather matrix — a 3-node multi-
 process net over real TCP with every link WAN-shaped (netem/) and the
 adaptive peer transport on, walked live through the named weather
@@ -413,6 +424,237 @@ def overload_main(smoke: bool) -> None:
         net.stop()
 
 
+def byzantine_main(smoke: bool) -> None:
+    """Byzantine vote-flood soak (see module docstring, --byzantine)."""
+    from txflow_tpu.abci.kvstore import KVStoreApplication
+    from txflow_tpu.faults.byzantine import (
+        ByzantineVoteGen,
+        IdenticalVoteReplayer,
+        SigGarbageFlooder,
+        StaleVoteSpammer,
+    )
+    from txflow_tpu.health.byzantine import ByzantineConfig
+
+    def stall(msg: str) -> None:
+        print(f"SOAK STALL: {msg}", flush=True)
+        sys.exit(1)
+
+    duration = 10.0 if smoke else 45.0
+    commit_wait = 30.0 if smoke else 120.0
+    cfg = test_config()
+    cfg.consensus.skip_timeout_commit = True
+    # production-shaped posture, armed from t=0: the soak proves the live
+    # breaker converges under full blast (the two-phase accounting proof
+    # lives in tests/test_byzantine_gossip.py). strike_penalty stays 0 so
+    # the scoreboard floor never tears down links mid-soak — link
+    # evict/redial churn is the overload soak's subject, not this one's.
+    byz = ByzantineConfig(
+        min_samples=24,
+        max_bad_rate=0.5,
+        stale_height_slack=8,
+        quarantine_replays=True,
+        replay_min_samples=48,
+        replay_max_rate=0.7,
+        quarantine_secs=600.0,
+        strike_penalty=0.0,
+        quarantine_penalty=0.5,
+    )
+    net = LocalNet(
+        4,
+        use_device_verifier=False,
+        enable_consensus=True,
+        config=cfg,
+        byzantine_config=byz,
+    )
+    # validator 0 turns Byzantine: its consensus identity stays (quorum is
+    # now exactly the 3 honest keys), its fast-path signer is disarmed,
+    # and its switch carries the flood
+    net.nodes[0].txvote_reactor.priv_val = None
+    gen0 = ByzantineVoteGen(net.priv_vals[0], net.chain_id, seed=1)
+    rogue = ByzantineVoteGen(
+        MockPV(hashlib.sha256(b"soak-rogue").digest()), net.chain_id, seed=2
+    )
+    evil = Node(
+        node_id="evil-peer",
+        chain_id=net.chain_id,
+        val_set=net.val_set,
+        app=KVStoreApplication(),
+        priv_val=None,
+        node_config=NodeConfig(
+            config=cfg,
+            use_device_verifier=False,
+            enable_consensus=False,
+            sign_votes=False,
+            health=False,
+            sync=False,
+            byzantine_config=byz,
+        ),
+    )
+
+    honest_txs: list[bytes] = []
+    # forgeries target ghost txs (never in any mempool): their vote slots
+    # stay open, so garbage signatures are actually judged on the verify
+    # path instead of late-dropping against committed txs
+    ghost_txs = [b"soak-ghost%d" % i for i in range(8)]
+    targets = lambda: ghost_txs + honest_txs  # noqa: E731
+    height_fn = lambda: net.nodes[1].state_view().last_block_height  # noqa: E731
+    drivers = [
+        SigGarbageFlooder(
+            net.nodes[0].switch, gen0, targets, height_fn,
+            victim_address=net.priv_vals[1].get_address(),
+            batch=8, interval=0.03,
+        ),
+        StaleVoteSpammer(
+            net.nodes[0].switch, gen0, targets, height_fn,
+            lag=1000, batch=4, interval=0.05,
+        ),
+        SigGarbageFlooder(
+            evil.switch, rogue, targets, height_fn, batch=12, interval=0.02
+        ),
+    ]
+    honest = lambda: net.nodes[1:]  # noqa: E731
+    rng = random.Random(99)
+    sent: list[bytes] = []
+    t_start = time.monotonic()
+    try:
+        net.start()
+        evil.start()
+        for n in net.nodes:
+            connect_switches(evil.switch, n.switch)
+        deadline = time.monotonic() + 60
+        while height_fn() < 10:
+            if time.monotonic() > deadline:
+                stall("consensus never reached height 10")
+            time.sleep(0.1)
+        # evil replays a frame of validly-signed ghost votes forever: the
+        # pool entries never purge, so every redelivery is a countable
+        # sender-repeat
+        drivers.append(
+            IdenticalVoteReplayer(
+                evil.switch,
+                [
+                    ByzantineVoteGen(
+                        net.priv_vals[2], net.chain_id
+                    ).honest_vote(tx, height_fn())
+                    for tx in ghost_txs[:3]
+                ],
+                interval=0.01,
+            )
+        )
+        for d in drivers:
+            d.start()
+
+        # continuous honest load while the flood runs at full blast
+        t0 = time.monotonic()
+        phase = 0
+        while time.monotonic() - t0 < duration:
+            phase += 1
+            for _ in range(rng.randrange(2, 6)):
+                tx = b"byz-soak-%d-%d=v" % (phase, rng.randrange(1 << 30))
+                sent.append(tx)
+                try:
+                    net.broadcast_tx(tx, node_index=rng.randrange(1, 4))
+                except Exception:
+                    pass
+            time.sleep(0.05)
+
+        # zero admitted-tx loss under the flood
+        tail = sent[-200:]
+        if not net.wait_all_committed(tail, timeout=commit_wait):
+            stall(
+                f"admitted txs failed to commit within {commit_wait:.0f}s "
+                f"under the Byzantine flood"
+            )
+        # every adversary struck AND quarantined on every honest node
+        q_deadline = time.monotonic() + 30
+        for nid in ("node0", "evil-peer"):
+            while not all(n.byzantine_ledger.quarantined(nid) for n in honest()):
+                if time.monotonic() > q_deadline:
+                    stall(f"{nid} never quarantined on every honest node")
+                time.sleep(0.2)
+            for n in honest():
+                if not n.byzantine_ledger.strikes_of(nid) > 0:
+                    stall(f"{nid} has no strikes on {n.node_id}")
+        # the front door is absorbing the still-running flood
+        gate_deadline = time.monotonic() + 20
+        while True:
+            gated = [
+                sum(
+                    p.get("drops", {}).get("quarantined", 0)
+                    for p in n.byzantine_ledger.snapshot()["peers"].values()
+                )
+                for n in honest()
+            ]
+            if all(g > 0 for g in gated):
+                break
+            if time.monotonic() > gate_deadline:
+                stall(f"front-door gate absorbed nothing: {gated}")
+            time.sleep(0.2)
+
+        # post-quarantine waste bound: drain in-flight verdicts, then
+        # commit a fresh batch under the (blocked) flood
+        def invalids():
+            return [int(n.metrics.invalid_votes.value()) for n in honest()]
+
+        stable = invalids()
+        stable_since = time.monotonic()
+        drain_deadline = time.monotonic() + 30
+        while time.monotonic() < drain_deadline:
+            cur = invalids()
+            if cur != stable:
+                stable, stable_since = cur, time.monotonic()
+            elif time.monotonic() - stable_since >= 1.0:
+                break
+            time.sleep(0.1)
+        base = [
+            (
+                int(n.metrics.verified_votes.value()),
+                int(n.metrics.invalid_votes.value()),
+            )
+            for n in honest()
+        ]
+        fresh = [b"byz-post-%d=v" % i for i in range(8)]
+        sent.extend(fresh)
+        for i, tx in enumerate(fresh):
+            net.broadcast_tx(tx, node_index=1 + i % 3)
+        if not net.wait_all_committed(fresh, timeout=commit_wait):
+            stall("post-quarantine batch failed to commit")
+        for n, (v0, i0) in zip(honest(), base):
+            dv = int(n.metrics.verified_votes.value()) - v0
+            di = int(n.metrics.invalid_votes.value()) - i0
+            if dv <= 0:
+                stall(f"{n.node_id}: no honest votes reached the device")
+            rate = di / (di + dv)
+            if rate >= 0.05:
+                stall(
+                    f"{n.node_id}: post-quarantine invalid rate {rate:.3f} "
+                    f"(invalid {di} / dispatched {di + dv})"
+                )
+
+        for d in drivers:
+            if not (d.frames > 0 and d.emitted > 0):
+                stall(f"adversary driver {type(d).__name__} never fired")
+        snaps = [n.byzantine_ledger.snapshot() for n in honest()]
+        drops = sum(s["pre_verify_drops"] for s in snaps)
+        strikes = sum(s["strikes"] for s in snaps)
+        quarantines = sum(s["quarantines"] for s in snaps)
+        emitted = sum(d.emitted for d in drivers)
+        print(
+            f"SOAK OK (byzantine): {duration:.0f}s flood "
+            f"({time.monotonic() - t_start:.0f}s total), "
+            f"{emitted} hostile votes emitted, {len(sent)} honest txs "
+            f"zero loss, {strikes} strikes / {quarantines} quarantines / "
+            f"{drops} pre-verify drops across honest nodes, "
+            f"post-quarantine invalid rate < 5% on every node",
+            flush=True,
+        )
+    finally:
+        for d in drivers:
+            d.stop()
+        evil.stop()
+        net.stop()
+
+
 def wan_matrix_main(smoke: bool) -> None:
     """WAN weather scenario matrix over real sockets (--wan-matrix).
 
@@ -735,6 +977,9 @@ def main() -> None:
         return
     if "--wan-matrix" in sys.argv:
         wan_matrix_main(smoke)
+        return
+    if "--byzantine" in sys.argv:
+        byzantine_main(smoke)
         return
     import jax
 

@@ -457,6 +457,16 @@ _HOT_NOBLOCK_FUNCS = {
     # return — the wire wait lives in the shaper's own deliver thread.
     # A blocking call here turns weather latency into sender stall.
     "txflow_tpu/netem/shaper.py": {"send", "try_send"},
+    # the accountable-gossip ledger sits on the vote-gossip receive path
+    # (quarantine gate + per-frame accounting) and the engine's verdict
+    # routing (invalid-origin attribution). A Byzantine flood IS the load
+    # these run under — a blocking call here hands the attacker a stall
+    # primitive on the exact path built to absorb them.
+    "txflow_tpu/health/byzantine.py": {
+        "quarantined", "note_frame", "note_invalid_origins",
+        "register_peer", "note_sync_strike", "strikes_of",
+        "_judge_locked", "_trip_locked",
+    },
 }
 
 
@@ -527,6 +537,10 @@ _TRACE_SCOPE = (
     # timeline: a pinned-clock test that shapes links would otherwise see
     # deliveries scheduled on a clock the spans don't use
     "txflow_tpu/netem/",
+    # quarantine expiry and breaker windows live on the gossip receive
+    # path's timeline: a pinned-clock drill must be able to walk a peer
+    # into and out of quarantine deterministically
+    "txflow_tpu/health/byzantine.py",
 )
 
 # the forbidden time.* names: every raw timestamp source. time.sleep is

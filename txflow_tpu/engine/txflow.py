@@ -386,6 +386,10 @@ class TxFlow:
         # per-tx tracing (trace/tracer.py): wired by the node before
         # start(); NULL_TRACER keeps every hook a no-op attribute check
         self.tracer = NULL_TRACER
+        # accountable gossip (health/byzantine.py, wired by the node):
+        # called outside _mtx with the ingest-origin sender id of every
+        # valid=False verdict in a routed batch. None = zero cost.
+        self.on_invalid_votes = None
         # tx_hash -> open commit_apply span id (begun at decision time
         # under _mtx, finished by whichever path applies: committer
         # batch, inline effects, late delivery, or a block via claim_vtx)
@@ -1281,6 +1285,10 @@ class TxFlow:
             # certificates are identical to the serial path, not padded
             # with same-batch late votes
             bad_keys: list[bytes] = []
+            # the valid=False slice only (bad_keys also carries late/dup
+            # removals, which are NOT peer misbehavior): resolved to
+            # ingest origins for the accountability hook below
+            invalid_keys: list[bytes] = []
             purge_votes: list[TxVote] = []  # quorum votes, ONE pool purge/step
             # a requeue re-enters through the lane that drained it — a
             # priority repeat must never wait out the bulk backlog
@@ -1329,6 +1337,7 @@ class TxFlow:
                 if not valid_l[i]:
                     self.metrics.invalid_votes.add(1)
                     bad_keys.append(keys[i])
+                    invalid_keys.append(keys[i])
                     continue
                 vs = self.vote_sets.get(vote.tx_hash)
                 if vs is None:
@@ -1368,8 +1377,24 @@ class TxFlow:
                             inline_commits.append(self._decide_commit(vs))
                 else:
                     bad_keys.append(keys[i])  # dup/conflict: can never add
+            invalid_origins = None
+            if invalid_keys and self.on_invalid_votes is not None:
+                # resolve BEFORE the remove below wipes the entries —
+                # same pool-lock-under-_mtx order as remove itself
+                invalid_origins = self.tx_vote_pool.origins_of(invalid_keys)
             if bad_keys:
                 self.tx_vote_pool.remove(bad_keys)
+
+        if invalid_origins is not None:
+            # accountability hook (health/byzantine.py ledger, wired by
+            # the node): each valid=False verdict, attributed to the peer
+            # whose delivery created the pool entry. Outside _mtx — the
+            # ledger takes its own lock and may punish the scoreboard;
+            # a hook fault must never take down the verify step.
+            try:
+                self.on_invalid_votes(invalid_origins)
+            except Exception:
+                pass
 
         for vs, quorum_votes, tx in inline_commits:
             # decision order preserved; _commit_effects re-acquires _mtx

@@ -13,8 +13,10 @@ first-class, seed-reproducible test input:
   ``Peer`` interceptor hook, schedules delayed deliveries, and cuts/heals
   partitions (chaos.py);
 - ``byzantine``          — equivocating / garbage-signature / stale /
-  wrong-chain TxVote generators and block-vote equivocation evidence
-  (byzantine.py);
+  wrong-chain TxVote generators, block-vote equivocation evidence, and
+  the live adversary fleet (sig-garbage flooder, identical-vote
+  replayer, stale spammer, txvote equivocator, selective withholder)
+  that drives the accountable-gossip drills (byzantine.py);
 - ``CrashDrill``         — build a durable node, kill it mid-run (optionally
   at a failpoint), restart from WAL + stores, and compare replayed state
   (crash.py);
@@ -31,8 +33,22 @@ from .crash import CrashDrill
 from .flaky import FlakyVerifier, InjectedDeviceError
 from .stake import churn_schedule, gini, stake_distribution
 from . import byzantine
+from .byzantine import (
+    ByzantineVoteGen,
+    IdenticalVoteReplayer,
+    SelectiveWithholder,
+    SigGarbageFlooder,
+    StaleVoteSpammer,
+    TxVoteEquivocator,
+)
 
 __all__ = [
+    "ByzantineVoteGen",
+    "SigGarbageFlooder",
+    "IdenticalVoteReplayer",
+    "StaleVoteSpammer",
+    "TxVoteEquivocator",
+    "SelectiveWithholder",
     "FaultPlan",
     "FaultSpec",
     "ChaosRouter",

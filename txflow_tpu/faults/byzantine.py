@@ -28,7 +28,9 @@ from __future__ import annotations
 
 import hashlib
 import random
+import threading
 
+from ..p2p.base import CHANNEL_TXVOTE
 from ..types.block_vote import PREVOTE, BlockVote
 from ..types.evidence import DuplicateBlockVoteEvidence
 from ..types.tx_vote import MAX_SIGNATURE_SIZE, TxVote
@@ -116,6 +118,21 @@ class ByzantineVoteGen:
         self.pv.sign_tx_vote(self.chain_id, v)
         return v
 
+    def wrong_chain_equivocating_pair(
+        self, tx: bytes, height: int = 0
+    ) -> tuple[TxVote, TxVote]:
+        """The other-chain signer, extended to vote-level equivocation:
+        TWO distinct signatures from one validator for one tx, both made
+        for a foreign chain id. Against OUR chain both fail verification
+        (two strikes for the origin peer), and the signer's key is now on
+        record double-signing — the block-path evidence bridge below
+        turns the same key's conduct into the slashable kind."""
+        a = self._vote(tx, height, timestamp_ns=1_700_000_000_000_000_000)
+        b = self._vote(tx, height, timestamp_ns=1_700_000_000_000_000_001)
+        self.pv.sign_tx_vote("byzantine-other-chain", a)
+        self.pv.sign_tx_vote("byzantine-other-chain", b)
+        return a, b
+
 
 def equivocating_block_votes(
     priv_val,
@@ -150,3 +167,291 @@ def forged_block_vote_evidence(
     ev = equivocating_block_votes(priv_val, chain_id, height)
     ev.vote_b.signature = b"\x01" * 64
     return ev
+
+
+# -- adversary fleet (ISSUE 14): live flood drivers ------------------------
+#
+# Each driver is a thread that crafts hostile vote frames and broadcasts
+# them on the TXVOTE channel THROUGH A SWITCH — exactly the byte stream a
+# compromised process would emit, entering honest nodes via the normal
+# reactor receive path (wire cache, pre-checks, pool, device verify).
+# Crucially the frames bypass the hostile node's OWN pool/engine: a real
+# adversary does not politely verify its garbage before sending, and
+# injecting into the local pool would let the local engine judge + remove
+# the votes before gossip picks them up.
+#
+# Drivers count what they emit (``frames``, ``emitted``) so drills can
+# assert against ground truth instead of inferring the attack volume.
+
+
+def _encode_vote_frame(votes: list[TxVote]) -> bytes:
+    # local twin of reactors.txvote_reactor.encode_vote_batch, kept here
+    # so faults/ does not import reactors/ (health/watchdog.py already
+    # imports the reactor module — keeping this layer leaf-ward avoids
+    # ever closing that cycle)
+    from ..codec import amino
+    from ..types import encode_tx_vote
+
+    body = bytearray([1])  # MSG_VOTES
+    for v in votes:
+        body += amino.length_prefixed(encode_tx_vote(v))
+    return bytes(body)
+
+
+class _FloodDriver:
+    """Base: a paced emit loop over a switch. Subclasses build one frame
+    per tick via ``_tick_votes()``; empty = skip the tick."""
+
+    name = "adversary"
+
+    def __init__(self, switch, interval: float = 0.02):
+        self.switch = switch
+        self.interval = interval
+        self.frames = 0
+        self.emitted = 0  # total votes across all frames
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _tick_votes(self) -> list[TxVote]:
+        raise NotImplementedError
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"byz-{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            votes = self._tick_votes()
+            if votes:
+                self.switch.broadcast(CHANNEL_TXVOTE, _encode_vote_frame(votes))
+                self.frames += 1
+                self.emitted += len(votes)
+            self._stop.wait(self.interval)
+
+
+class SigGarbageFlooder(_FloodDriver):
+    """Floods forged signatures for real txs: a rotation of garbage
+    bytes, other-chain re-signs, and forged-address claims — every one
+    costs the honest net a verify slot until the breaker quarantines the
+    sender. ``txs`` is a callable returning the current target tx bytes
+    (drills point it at the live honest workload)."""
+
+    name = "sig-garbage"
+
+    def __init__(
+        self, switch, gen: ByzantineVoteGen, txs, height_fn,
+        victim_address: bytes | None = None,
+        batch: int = 32, interval: float = 0.02,
+    ):
+        super().__init__(switch, interval)
+        self.gen = gen
+        self.txs = txs
+        self.height_fn = height_fn
+        self.victim_address = victim_address
+        self.batch = batch
+        self._n = 0
+
+    def _tick_votes(self) -> list[TxVote]:
+        txs = self.txs()
+        if not txs:
+            return []
+        h = self.height_fn()
+        out = []
+        for _ in range(self.batch):
+            tx = txs[self._n % len(txs)]
+            kind = self._n % 3
+            self._n += 1
+            if kind == 0:
+                out.append(self.gen.garbage_signature_vote(tx, h))
+            elif kind == 1 or self.victim_address is None:
+                out.append(self.gen.wrong_chain_vote(tx, h))
+            else:
+                out.append(
+                    self.gen.forged_address_vote(tx, self.victim_address, h)
+                )
+        return out
+
+
+class IdenticalVoteReplayer(_FloodDriver):
+    """Replays ONE frame of validly-signed votes forever: the cheapest
+    possible flood (no signing cost per tick). Honest defense in depth:
+    the pool's signature dedup absorbs it, the verdict cache guarantees
+    zero repeat device dispatches, and the ledger's replay counters make
+    the sender visible (quarantinable where ``quarantine_replays`` is
+    on). The frame is frozen at start — call ``reload`` to re-arm with
+    fresh votes."""
+
+    name = "replayer"
+
+    def __init__(self, switch, votes: list[TxVote], interval: float = 0.005):
+        super().__init__(switch, interval)
+        self._votes = list(votes)
+        self._frame = _encode_vote_frame(self._votes)
+
+    def reload(self, votes: list[TxVote]) -> None:
+        self._votes = list(votes)
+        self._frame = _encode_vote_frame(self._votes)
+
+    def _run(self) -> None:  # frame prebuilt: skip per-tick encode
+        while not self._stop.is_set():
+            if self._votes:
+                self.switch.broadcast(CHANNEL_TXVOTE, self._frame)
+                self.frames += 1
+                self.emitted += len(self._votes)
+            self._stop.wait(self.interval)
+
+    def _tick_votes(self) -> list[TxVote]:  # pragma: no cover - unused
+        return self._votes
+
+
+class StaleVoteSpammer(_FloodDriver):
+    """Floods validly-signed votes for heights far behind the net (the
+    withhold-then-release pattern). Timestamps advance per tick so every
+    frame is new signatures — pure dedup cannot absorb it; the
+    stale-height pre-check must."""
+
+    name = "stale"
+
+    def __init__(
+        self, switch, gen: ByzantineVoteGen, txs, height_fn,
+        lag: int = 1000, batch: int = 16, interval: float = 0.02,
+    ):
+        super().__init__(switch, interval)
+        self.gen = gen
+        self.txs = txs
+        self.height_fn = height_fn
+        self.lag = lag
+        self.batch = batch
+        self._ts = 1_600_000_000_000_000_000
+
+    def _tick_votes(self) -> list[TxVote]:
+        txs = self.txs()
+        if not txs:
+            return []
+        h = self.height_fn()
+        out = []
+        for i in range(self.batch):
+            v = self.gen._vote(
+                txs[i % len(txs)], max(0, h - self.lag), timestamp_ns=self._ts
+            )
+            self._ts += 1
+            self.gen.pv.sign_tx_vote(self.gen.chain_id, v)
+            out.append(v)
+        return out
+
+
+class TxVoteEquivocator(_FloodDriver):
+    """Emits vote-level equivocation: pairs of distinct valid signatures
+    per (tx, validator) on the fast path (stake counted once, first-
+    signature-wins — NOT evidence by design), plus other-chain
+    equivocating pairs (two invalid strikes each). ``block_evidence``
+    bridges the same signer's conduct into the slashable block-path
+    kind for the PR 7 evidence -> slash drill."""
+
+    name = "equivocator"
+
+    def __init__(
+        self, switch, gen: ByzantineVoteGen, txs, height_fn,
+        wrong_chain: bool = False, interval: float = 0.05,
+    ):
+        super().__init__(switch, interval)
+        self.gen = gen
+        self.txs = txs
+        self.height_fn = height_fn
+        self.wrong_chain = wrong_chain
+        self._n = 0
+
+    def _tick_votes(self) -> list[TxVote]:
+        txs = self.txs()
+        if not txs:
+            return []
+        tx = txs[self._n % len(txs)]
+        self._n += 1
+        h = self.height_fn()
+        if self.wrong_chain:
+            a, b = self.gen.wrong_chain_equivocating_pair(tx, h)
+        else:
+            a, b = self.gen.equivocating_pair(tx, h)
+        return [a, b]
+
+    def block_evidence(self, height: int) -> DuplicateBlockVoteEvidence:
+        """The same signer equivocating on the BLOCK path — the kind the
+        evidence pool admits and the epoch manager slashes."""
+        return equivocating_block_votes(self.gen.pv, self.gen.chain_id, height)
+
+
+class SelectiveWithholder:
+    """A validator that signs only the txs it favors. Not a flood — a
+    LIVENESS adversary: install on a node (replacing its sign routine)
+    and it signs txs matching ``predicate`` while silently withholding
+    the rest. Safety is unaffected; withheld txs still commit iff the
+    remaining honest stake clears 2n/3 without this key."""
+
+    name = "withholder"
+
+    def __init__(self, node, predicate, interval: float = 0.01, batch: int = 256):
+        self.node = node
+        self.predicate = predicate
+        self.interval = interval
+        self.batch = batch
+        self.signed = 0
+        self.withheld = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def install(self) -> None:
+        """Disarm the node's honest sign routine (keep its validator
+        identity) and start the selective one. Call BEFORE node.start()."""
+        self.node.txvote_reactor.priv_val = None
+        self.start()
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="byz-withholder", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _run(self) -> None:
+        node = self.node
+        pv = node.priv_val
+        cursor = 0
+        while not self._stop.is_set():
+            items, cursor = node.mempool.entries_from(cursor, limit=self.batch)
+            if not items:
+                self._stop.wait(self.interval)
+                continue
+            st = node.state_view()
+            for tx_key, tx, _h, fast_path, _lane in items:
+                if not fast_path:
+                    continue
+                if not self.predicate(tx):
+                    self.withheld += 1
+                    continue
+                vote = TxVote(
+                    height=st.last_block_height,
+                    tx_hash=tx_key.hex().upper(),
+                    tx_key=tx_key,
+                    validator_address=pv.get_address(),
+                )
+                pv.sign_tx_vote(st.chain_id, vote)
+                try:
+                    node.tx_vote_pool.check_tx(vote)
+                    self.signed += 1
+                except Exception:
+                    continue

@@ -1,0 +1,350 @@
+"""ByzantineLedger: one per-node ledger of peer misbehavior on the vote
+gossip path, unifying what used to be two disconnected mechanisms:
+
+- the sync client's Byzantine strikes (sync/manager.py ``_strike`` — a
+  peer caught serving forged certificates), previously a private
+  ``_banned`` dict locked inside the sync subsystem;
+- NEW gossip accountability: every per-vote ``valid=False`` bit the
+  batched verifier produces is attributed back to the peer whose
+  delivery put that vote in the pool (its ingest *origin*), and every
+  O(1) ingest pre-check drop (unknown validator, stale height, replayed
+  signature) is counted against the relaying peer.
+
+Both write the same per-peer record and the same scoreboard
+(health/peers.py ``PeerScoreBoard.punish`` — score floor -> evict ->
+jittered-backoff reconnect), surfaced as the ``byzantine`` section of
+``/health`` and the ``txflow_byzantine_*`` metrics family.
+
+The circuit breaker: each peer's *judged* gossip events (pre-check
+drops + device verdicts attributed to it) form a decaying window; when
+the window holds enough samples and the bad fraction crosses
+``max_bad_rate``, the peer is quarantined for ``quarantine_secs`` — the
+reactor then drops its whole MSG_VOTES frames at the front door, BEFORE
+decode and before the pool, so a flooding peer stops costing device
+dispatches (and host decodes) the moment the breaker trips.
+
+Attribution is by ORIGIN, not by the full sender set: the origin is the
+peer whose delivery actually created the pool entry — the delivery that
+cost the device slot. Later duplicate senders (honest gossip redundancy
+racing the verdict) cost nothing on the device and are not struck, so an
+honest node that innocently relays a flooder's garbage one hop is not
+punished for the flooder's crime (it loses at most the rare races where
+its relay arrived first).
+
+Replays (same peer re-sending a signature it already delivered) are
+counted and surfaced but do NOT feed the breaker by default
+(``quarantine_replays``): the quorum-stall watchdog's re-offer frames
+are legitimate same-peer repeats, and a replay never reaches the device
+anyway (pool signature dedup + the verifier's verdict cache make it
+O(1)). Drills and deployments that want replay floods quarantined opt
+in.
+
+The ledger is lock-cheap by design: every note_* call is a few dict
+operations under one small mutex — it runs on gossip receive threads
+and at the tail of the engine's route stage, never under the pool lock.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..analysis.lockgraph import make_lock
+from ..utils.clock import monotonic
+from ..utils.metrics import ByzantineMetrics
+
+# pre-check drop reasons (counter keys; also the /health breakdown)
+DROP_UNKNOWN_VALIDATOR = "unknown_validator"
+DROP_STALE_HEIGHT = "stale_height"
+DROP_REPLAYED_SIG = "replayed_sig"
+DROP_QUARANTINED = "quarantined"
+
+_BREAKER_REASONS = (DROP_UNKNOWN_VALIDATOR, DROP_STALE_HEIGHT)
+
+
+@dataclass
+class ByzantineConfig:
+    # scoreboard points per invalid-signature verdict attributed to a
+    # peer (cumulative; the floor at -8.0 evicts through the normal
+    # reconnect/backoff machinery once a reconnector is wired)
+    strike_penalty: float = 0.75
+    # one-shot punishment when the breaker trips (matches the sync
+    # client's byzantine_penalty posture: crosses the floor immediately)
+    quarantine_penalty: float = 16.0
+    # how long a tripped peer's MSG_VOTES frames are dropped at ingest
+    quarantine_secs: float = 30.0
+    # circuit breaker: judged-event window with exponential decay —
+    # once a peer's window holds >= min_samples and bad/total >=
+    # max_bad_rate, the peer is quarantined. Judged events are kept
+    # ingests + breaker-reason drops + attributed verdicts; the window
+    # halves (count and bad together, preserving the ratio) whenever it
+    # reaches `window`, so old behavior ages out instead of pinning a
+    # reformed peer at its worst hour.
+    window: int = 256
+    min_samples: int = 32
+    max_bad_rate: float = 0.5
+    # stale-height pre-check slack: a vote whose height is more than
+    # this many blocks behind our state is dropped before the pool.
+    # Generous by default — watchdog re-offers and catch-up regossip
+    # legitimately carry somewhat-old heights; the byzantine stale
+    # spammer is hundreds of blocks behind.
+    stale_height_slack: int = 32
+    # count same-peer identical re-sends toward the breaker. Off by
+    # default (see module docstring: watchdog re-offers are honest
+    # same-peer repeats); replay-flood drills opt in.
+    quarantine_replays: bool = False
+    replay_min_samples: int = 256
+    replay_max_rate: float = 0.9
+
+
+class _PeerRecord:
+    __slots__ = (
+        "node_id", "relayed", "invalid", "strikes", "quarantines",
+        "sync_strikes", "drops", "quarantined_until",
+        "win_events", "win_bad", "win_replay",
+    )
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self.relayed = 0  # votes this peer delivered that we kept
+        self.invalid = 0  # device verdicts valid=False attributed here
+        self.strikes = 0  # invalid verdicts + breaker trips + sync strikes
+        self.quarantines = 0
+        self.sync_strikes = 0
+        self.drops: dict[str, int] = {}
+        self.quarantined_until = 0.0
+        self.win_events = 0
+        self.win_bad = 0
+        self.win_replay = 0
+
+
+class ByzantineLedger:
+    def __init__(
+        self,
+        cfg: ByzantineConfig | None = None,
+        scoreboard=None,  # PeerScoreBoard | None (wired post-health)
+        metrics_registry=None,
+    ):
+        self.cfg = cfg or ByzantineConfig()
+        self.scoreboard = scoreboard
+        self.metrics = ByzantineMetrics(metrics_registry)
+        self._mtx = make_lock("health.ByzantineLedger._mtx")
+        self._peers: dict[str, _PeerRecord] = {}
+        self._pids: dict[int, str] = {}  # pool sender id -> node_id
+        # process totals (cheap snapshot without walking peers)
+        self._total_strikes = 0
+        self._total_quarantines = 0
+        self._total_pre_drops = 0
+
+    # -- peer identity --
+
+    def register_peer(self, pid: int, node_id: str) -> None:
+        """Bind a pool sender id (the reactor's small int) to the peer's
+        node_id so engine-side verdict attribution can reach the
+        scoreboard, which keys on node ids."""
+        with self._mtx:
+            self._pids[pid] = node_id
+            if node_id not in self._peers:
+                self._peers[node_id] = _PeerRecord(node_id)
+
+    def _rec(self, node_id: str) -> _PeerRecord:
+        rec = self._peers.get(node_id)
+        if rec is None:
+            rec = self._peers[node_id] = _PeerRecord(node_id)
+        return rec
+
+    # -- quarantine gate (reactor front door, O(1)) --
+
+    def quarantined(self, node_id: str, now: float | None = None) -> bool:
+        if now is None:
+            now = monotonic()
+        with self._mtx:
+            rec = self._peers.get(node_id)
+            return rec is not None and now < rec.quarantined_until
+
+    # -- ingest accounting (reactor receive path, one call per frame) --
+
+    def note_frame(
+        self, node_id: str, kept: int, drops: dict[str, int] | None = None,
+        now: float | None = None,
+    ) -> None:
+        """One gossip frame's verdict from the pre-check filter: `kept`
+        votes went on to the pool, `drops` maps reason -> count for the
+        rest. Breaker-reason drops count as bad window events."""
+        if now is None:
+            now = monotonic()
+        trip = None
+        m = self.metrics
+        with self._mtx:
+            rec = self._rec(node_id)
+            rec.relayed += kept
+            rec.win_events += kept
+            if drops:
+                for reason, n in drops.items():
+                    if n <= 0:
+                        continue
+                    rec.drops[reason] = rec.drops.get(reason, 0) + n
+                    if reason != DROP_QUARANTINED:
+                        self._total_pre_drops += n
+                    if reason in _BREAKER_REASONS:
+                        rec.win_events += n
+                        rec.win_bad += n
+                    elif reason == DROP_REPLAYED_SIG:
+                        rec.win_events += n
+                        rec.win_replay += n
+                        if self.cfg.quarantine_replays:
+                            rec.win_bad += n
+            trip = self._judge_locked(rec, now)
+        if drops:
+            for reason, n in drops.items():
+                ctr = m.drop_counters.get(reason)
+                if ctr is not None and n > 0:
+                    ctr.add(n)
+        if trip is not None:
+            self._after_trip(trip)
+
+    # -- verdict attribution (engine route tail, one call per batch) --
+
+    def note_invalid_origins(
+        self, origins: list[int], now: float | None = None
+    ) -> None:
+        """Device verdicts: each entry is the pool sender id that
+        originated one valid=False vote. Unknown / local origins (id 0,
+        RPC, WAL replay) are skipped — there is no peer to strike."""
+        if now is None:
+            now = monotonic()
+        per_peer: dict[str, int] = {}
+        with self._mtx:
+            for pid in origins:
+                nid = self._pids.get(pid)
+                if nid is None:
+                    continue
+                per_peer[nid] = per_peer.get(nid, 0) + 1
+            trips = []
+            for nid, n in per_peer.items():
+                rec = self._rec(nid)
+                rec.invalid += n
+                rec.strikes += n
+                self._total_strikes += n
+                rec.win_events += n
+                rec.win_bad += n
+                trip = self._judge_locked(rec, now)
+                if trip is not None:
+                    trips.append(trip)
+        if per_peer:
+            n_total = sum(per_peer.values())
+            self.metrics.invalid_votes.add(n_total)
+            self.metrics.strikes.add(n_total)
+            sb = self.scoreboard
+            if sb is not None:
+                for nid, n in per_peer.items():
+                    sb.punish(nid, self.cfg.strike_penalty * n, now=now)
+        for trip in trips:
+            self._after_trip(trip)
+
+    # -- sync unification (SyncManager._strike byzantine branch) --
+
+    def note_sync_strike(self, node_id: str, now: float | None = None) -> None:
+        """A sync server was caught serving forged/truncated data (the
+        PR 9 machinery). The sync client keeps its own ban + advert
+        bookkeeping AND applies its own scoreboard penalty; this records
+        the strike on the unified ledger and quarantines the peer's VOTE
+        traffic too — a peer proven to forge certificates has no
+        business feeding our verify batches. No scoreboard punish here:
+        the caller already did, and double-charging one offense would
+        misstate the score history."""
+        if now is None:
+            now = monotonic()
+        with self._mtx:
+            rec = self._rec(node_id)
+            rec.sync_strikes += 1
+            rec.strikes += 1
+            self._total_strikes += 1
+            trip = self._trip_locked(rec, now)
+        self.metrics.strikes.add(1)
+        self._after_trip(trip, punish=False)
+
+    # -- the breaker --
+
+    def _judge_locked(self, rec: _PeerRecord, now: float):
+        """Under _mtx: decay the window and trip the breaker if the
+        peer's judged-bad fraction crossed the line. Returns the trip
+        (node_id) or None; side effects outside the lock."""
+        cfg = self.cfg
+        trip = None
+        if now >= rec.quarantined_until:
+            bad_trip = (
+                rec.win_events >= cfg.min_samples
+                and rec.win_bad / rec.win_events >= cfg.max_bad_rate
+            )
+            replay_trip = (
+                cfg.quarantine_replays
+                and rec.win_events >= cfg.replay_min_samples
+                and rec.win_replay / rec.win_events >= cfg.replay_max_rate
+            )
+            if bad_trip or replay_trip:
+                trip = self._trip_locked(rec, now)
+        if rec.win_events >= cfg.window:
+            # exponential decay, ratio-preserving: old sins age out
+            rec.win_events //= 2
+            rec.win_bad //= 2
+            rec.win_replay //= 2
+        return trip
+
+    def _trip_locked(self, rec: _PeerRecord, now: float):
+        rec.quarantined_until = now + self.cfg.quarantine_secs
+        rec.quarantines += 1
+        rec.strikes += 1
+        self._total_quarantines += 1
+        self._total_strikes += 1
+        # fresh window after the sentence: the peer is judged anew
+        rec.win_events = rec.win_bad = rec.win_replay = 0
+        return rec.node_id
+
+    def _after_trip(self, node_id: str | None, punish: bool = True) -> None:
+        if node_id is None:
+            return
+        self.metrics.quarantines.add(1)
+        self.metrics.strikes.add(1)
+        sb = self.scoreboard
+        if punish and sb is not None:
+            sb.punish(node_id, self.cfg.quarantine_penalty)
+
+    # -- introspection (/health "byzantine" section) --
+
+    def strikes_of(self, node_id: str) -> int:
+        with self._mtx:
+            rec = self._peers.get(node_id)
+            return rec.strikes if rec is not None else 0
+
+    def snapshot(self, now: float | None = None) -> dict:
+        if now is None:
+            now = monotonic()
+        with self._mtx:
+            peers = {}
+            quarantined = []
+            for nid, rec in self._peers.items():
+                q = now < rec.quarantined_until
+                if q:
+                    quarantined.append(nid)
+                if not (
+                    rec.strikes or rec.drops or rec.invalid or rec.relayed
+                ):
+                    continue  # registered but silent: keep /health small
+                peers[nid] = {
+                    "relayed": rec.relayed,
+                    "invalid": rec.invalid,
+                    "strikes": rec.strikes,
+                    "sync_strikes": rec.sync_strikes,
+                    "quarantines": rec.quarantines,
+                    "quarantined": q,
+                    "drops": dict(rec.drops),
+                }
+            snap = {
+                "strikes": self._total_strikes,
+                "quarantines": self._total_quarantines,
+                "pre_verify_drops": self._total_pre_drops,
+                "quarantined_peers": quarantined,
+                "peers": peers,
+            }
+        self.metrics.quarantined_peers.set(float(len(quarantined)))
+        return snap

@@ -40,6 +40,7 @@ class DegradedModeRegistry:
         self._sync: dict = {}
         self._storage: dict = {}
         self._network: dict = {}
+        self._byzantine: dict = {}
         self._watchdog_state: dict = {"inflight": 0, "oldest_stall_age": 0.0}
         self._healthy = True
 
@@ -167,6 +168,13 @@ class DegradedModeRegistry:
         # block path (or a recovered peer) closes the gap
         sm = getattr(node, "sync_manager", None)
         sync_state = sm.snapshot() if sm is not None else {}
+        # accountable vote gossip (health/byzantine.py): the unified
+        # strike ledger — gossip verdict strikes, pre-verify drops by
+        # reason, sync forgery strikes, and active quarantines — in one
+        # section, so "who is attacking this node and what is it
+        # costing" never requires correlating three subsystems
+        bl = getattr(node, "byzantine_ledger", None)
+        byz_state = bl.snapshot() if bl is not None else {}
         # durable-path degradation (engine save / pool WALs): a node that
         # cannot persist commits is loudly degraded, never silently lossy
         storage_state = {
@@ -199,6 +207,7 @@ class DegradedModeRegistry:
             self._sync = sync_state
             self._storage = storage_state
             self._network = network
+            self._byzantine = byz_state
             self._healthy = healthy
         self.metrics.healthy.set(1.0 if healthy else 0.0)
 
@@ -235,4 +244,5 @@ class DegradedModeRegistry:
                 "sync": dict(self._sync),
                 "storage": dict(self._storage),
                 "network": dict(self._network),
+                "byzantine": dict(self._byzantine),
             }

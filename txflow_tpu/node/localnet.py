@@ -49,6 +49,7 @@ class LocalNet:
         regossip_interval: float | None = None,
         health: bool = True,
         health_config=None,  # HealthConfig override (health/config.py)
+        byzantine_config=None,  # ByzantineConfig override (health/byzantine.py)
         voting_powers: list[int] | None = None,  # per-validator stake override
         epoch_config=None,  # EpochConfig: rotation/slashing (epoch/)
         sync: bool = True,  # catch-up sync channel + client (sync/)
@@ -159,6 +160,7 @@ class LocalNet:
         self._regossip_interval = regossip_interval
         self._health = health
         self._health_config = health_config
+        self._byzantine_config = byzantine_config
         self._epoch_config = epoch_config
         self._sync = sync
         self._sync_config = sync_config
@@ -216,6 +218,7 @@ class LocalNet:
                 regossip_interval=self._regossip_interval,
                 health=self._health,
                 health_config=self._health_config,
+                byzantine_config=self._byzantine_config,
                 epoch_config=self._epoch_config,
                 sync=self._sync,
                 sync_config=self._sync_config,

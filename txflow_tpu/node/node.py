@@ -85,6 +85,11 @@ class NodeConfig:
     health: bool = True
     # HealthConfig override (None = defaults; see health/config.py)
     health_config: object = None
+    # accountable vote gossip (health/byzantine.py): ByzantineConfig
+    # override for the per-peer strike ledger + invalid-rate circuit
+    # breaker. None = defaults; the ledger itself is always assembled
+    # (it is a few dicts — the hooks are no-ops without traffic)
+    byzantine_config: object = None
     # overload-resilient front door (admission/): edge dedup before any
     # signature work, pool-pressure backpressure to RPC (429) and ingest
     # gossip, fee/priority mempool lanes. False = open door (seed
@@ -230,6 +235,18 @@ class Node:
         self.mempool.tracer = self.tracer
         self.tx_vote_pool.tracer = self.tracer
 
+        # -- accountable vote gossip (health/byzantine.py): ONE ledger
+        # per node, shared by the reactor (pre-check drops + quarantine
+        # gate), the engine (invalid-verdict attribution), and the sync
+        # client (forged-data strikes). Built before the engine/reactors
+        # so their hooks bind at assembly; the scoreboard half is wired
+        # after the health layer exists below --
+        from ..health.byzantine import ByzantineLedger
+
+        self.byzantine_ledger = ByzantineLedger(
+            nc.byzantine_config, metrics_registry=self.metrics_registry
+        )
+
         # -- epoch manager (epoch/): slashing + scheduled rotation folded
         # into EndBlock validator updates at deterministic boundaries.
         # Every node runs the same pure fold over the committed chain, so
@@ -305,6 +322,9 @@ class Node:
         # before txflow.start(): the coalescer built at start() captures
         # the tracer for its linger spans
         self.txflow.tracer = self.tracer
+        # every valid=False verdict becomes a ledger strike against the
+        # peer whose delivery originated the vote (engine _route_result)
+        self.txflow.on_invalid_votes = self.byzantine_ledger.note_invalid_origins
 
         # -- switch + reactors (node/node.go:688-722; wiring bug fixed) --
         self.switch = Switch(node_id, node_seed=nc.node_key_seed)
@@ -340,6 +360,8 @@ class Node:
         )
         self.mempool_reactor.tracer = self.tracer
         self.txvote_reactor.tracer = self.tracer
+        # quarantine gate + O(1) pre-check drop accounting at vote ingest
+        self.txvote_reactor.ledger = self.byzantine_ledger
         self.switch.add_reactor("mempool", self.mempool_reactor)
         self.switch.add_reactor("txvote", self.txvote_reactor)
 
@@ -435,6 +457,9 @@ class Node:
             from ..health import HealthMonitor
 
             self.health = HealthMonitor(self, nc.health_config)
+            # strikes now reach the same score -> floor -> evict/backoff
+            # machinery that drives the rest of peer health
+            self.byzantine_ledger.scoreboard = self.health.scoreboard
             if self.address_book is not None:
                 # default reconnect hook for TCP assemblies: evicted
                 # peers re-dial via the PEX address book (the jittered
@@ -471,6 +496,7 @@ class Node:
                 scoreboard=self.health.scoreboard if self.health else None,
                 metrics=SyncMetrics(self.metrics_registry),
                 tracer=self.tracer,
+                ledger=self.byzantine_ledger,
             )
             self.sync_reactor.manager = self.sync_manager
             self.switch.add_reactor("sync", self.sync_reactor)

@@ -69,6 +69,13 @@ class _PoolVote:
     # eviction, late tx arrival) — a vote is delivered by EXACTLY the
     # log its ingest classified it into. Set by BOTH ingest twins.
     lane: int = -1
+    # ORIGIN: the sender whose delivery created this entry (first element
+    # of `senders`, frozen at ingest). Invalid-signature verdicts are
+    # attributed to the origin, not the whole sender set — later
+    # duplicate senders never cost a device slot, and striking them
+    # would punish honest gossip redundancy (health/byzantine.py).
+    # UNKNOWN_PEER_ID = local/RPC/WAL ingest: no peer to strike.
+    origin: int = 0
 
 
 class TxVotePool(IngestLogPool):
@@ -182,17 +189,42 @@ class TxVotePool(IngestLogPool):
                 out.append(entry is not None and sender_id in entry.senders)
             return out
 
-    def add_sender(self, key: bytes, sender_id: int) -> bool:
+    # add_sender return codes (truthiness preserved for old callers:
+    # 0 is still "fall back to check_tx")
+    SENDER_GONE = 0  # pool no longer holds the vote
+    SENDER_ADDED = 1  # new sender recorded
+    SENDER_REPEAT = 2  # this peer ALREADY sent this signature (replay)
+
+    def add_sender(self, key: bytes, sender_id: int) -> int:
         """Record that a peer holds this vote without re-ingesting it (the
-        reactor's wire-level dup fast path). Returns False when the pool no
-        longer holds the vote — the caller must fall back to a real
-        check_tx so pool-level re-accept policy stays authoritative."""
+        reactor's wire-level dup fast path). Returns SENDER_GONE when the
+        pool no longer holds the vote — the caller must fall back to a
+        real check_tx so pool-level re-accept policy stays authoritative.
+        SENDER_REPEAT distinguishes the same peer re-sending an identical
+        signature (replay accounting, health/byzantine.py) from a first
+        delivery by an additional peer (honest gossip redundancy)."""
         with self._mtx:
             entry = self._votes.get(key)
             if entry is None:
-                return False
+                return self.SENDER_GONE
+            if sender_id in entry.senders:
+                return self.SENDER_REPEAT
             entry.senders.add(sender_id)
-            return True
+            return self.SENDER_ADDED
+
+    def origins_of(self, keys: list[bytes]) -> list[int]:
+        """Ingest origin (pool sender id) for each key, one lock hold;
+        UNKNOWN_PEER_ID for keys already removed or locally ingested.
+        The engine calls this for the invalid slice of a verify batch
+        just before removing it, while still holding its own lock — so
+        the entries are guaranteed present and attribution is exact."""
+        with self._mtx:
+            votes = self._votes
+            out = []
+            for k in keys:
+                entry = votes.get(k)
+                out.append(UNKNOWN_PEER_ID if entry is None else entry.origin)
+            return out
 
     def _lane_quiet(self, vote: TxVote) -> int:
         """lane_of_vote with the hook-fault demotion applied (any error,
@@ -330,6 +362,7 @@ class TxVotePool(IngestLogPool):
                     entry.size = vote_size
                     entry.seg = seg
                     entry.lane = lane
+                    entry.origin = sid
                     votes_d[key] = entry
                     by_tx = self._by_tx.get(vote.tx_hash)
                     if by_tx is None:
@@ -397,7 +430,7 @@ class TxVotePool(IngestLogPool):
             object.__setattr__(vote, "_seg_cache", seg)
         entry = _PoolVote(
             self.height, vote, {tx_info.sender_id}, vote_size, seg=seg,
-            lane=lane,
+            lane=lane, origin=tx_info.sender_id,
         )
         self._votes[key] = entry
         by_tx = self._by_tx.get(vote.tx_hash)

@@ -28,7 +28,7 @@ from dataclasses import dataclass
 from typing import Callable
 
 from ..codec import amino
-from ..trace.tracer import NULL_TRACER, SPAN_SIGN
+from ..trace.tracer import NULL_TRACER, SPAN_PRE_DROP, SPAN_SIGN
 from ..utils.clock import monotonic
 from ..p2p.base import CHANNEL_TXVOTE, ChannelDescriptor, Reactor
 from ..pool.mempool import (
@@ -106,6 +106,10 @@ class TxVoteReactor(Reactor):
         # per-tx tracing (trace/tracer.py): the sign walk records a
         # sign_walk span per sampled tx; wired by the node
         self.tracer = NULL_TRACER
+        # accountable gossip (health/byzantine.py, wired by the node):
+        # quarantine gate + O(1) pre-check drop accounting. None = every
+        # check below short-circuits to the pre-ledger behavior.
+        self.ledger = None
         self._running = threading.Event()
         self._peer_ids: dict[str, int] = {}  # node_id -> small int (txVotePoolIDs)
         self._next_peer_id = 1
@@ -159,6 +163,11 @@ class TxVoteReactor(Reactor):
                 pid = self._next_peer_id
                 self._next_peer_id += 1
                 self._peer_ids[peer.node_id] = pid
+                if self.ledger is not None:
+                    # bind the pool sender id to the peer's node_id so
+                    # engine-side verdict attribution reaches the
+                    # scoreboard (which keys on node ids)
+                    self.ledger.register_peer(pid, peer.node_id)
             return pid
 
     def add_peer(self, peer) -> None:
@@ -191,6 +200,19 @@ class TxVoteReactor(Reactor):
             raise ValueError("empty txvote message")
         msg_type = msg[0]
         if msg_type == MSG_VOTES:
+            ledger = self.ledger
+            if ledger is not None and ledger.quarantined(peer.node_id):
+                # circuit breaker tripped for this peer: drop the whole
+                # frame at the front door. The uvarint skip-walk counts
+                # segments without decoding a single vote — a flooding
+                # peer costs O(frame bytes) here, never a device slot.
+                n = 0
+                r = amino.AminoReader(msg, 1)
+                while not r.eof():
+                    r.read_bytes()
+                    n += 1
+                ledger.note_frame(peer.node_id, 0, {"quarantined": n})
+                return
             pid = self._peer_id(peer)
             r = amino.AminoReader(msg, 1)
             pool = self.tx_vote_pool
@@ -199,6 +221,7 @@ class TxVoteReactor(Reactor):
             ingest: list = []  # (wk, vote) needing the authoritative path
             fresh_segs: list[bytes] = []  # wire-cache misses: batch decode
             fresh_slots: list[int] = []  # their ingest positions
+            n_replayed = 0  # same-peer identical re-sends (ledger window)
             while not r.eof():
                 seg = r.read_bytes()  # decode error -> peer stopped
                 # raw seg bytes ARE the cache key: siphash of ~150 B costs
@@ -208,15 +231,23 @@ class TxVoteReactor(Reactor):
                 hit = seen.peek(wk)
                 if hit is not None:
                     vk, vote = hit
-                    if pool.add_sender(vk, pid):
+                    code = pool.add_sender(vk, pid)
+                    if code:
                         # dup AND the pool still holds it: nothing to do
                         # beyond the peer's dup counter (health scoring —
                         # legit gossip redundancy is discounted there).
+                        # SENDER_REPEAT = THIS peer already delivered this
+                        # exact signature: counted for the ledger's replay
+                        # accounting (an honest watchdog re-offer or a
+                        # replay flood — the breaker's opt-in rate
+                        # threshold tells them apart).
                         # If the pool dropped it (purge/flush/eviction),
                         # fall through to the authoritative check_tx path
                         # — the wire cache must never overrule the pool's
                         # own re-accept policy (r3 review finding) — but
                         # reuse the shared decoded object either way.
+                        if code == TxVotePool.SENDER_REPEAT:
+                            n_replayed += 1
                         peer.stats.duplicates += 1
                         continue
                     if pool.in_cache(vk):
@@ -244,6 +275,33 @@ class TxVoteReactor(Reactor):
                     fresh_slots, decode_tx_votes_many(fresh_segs)
                 ):
                     ingest[slot] = (ingest[slot][0], vote)
+            n_unknown = n_stale = 0
+            if ingest and ledger is not None:
+                # O(1)-per-vote pre-checks, BEFORE the pool and the
+                # device: a vote from a signer outside the validator set
+                # can never reach quorum, and a vote far below our height
+                # is either ancient re-gossip or a stale-flood — both die
+                # here, order-preserving for everything kept (honest
+                # certificate parity). Pre-dropped segs deliberately do
+                # NOT enter the wire cache: each re-delivery is re-judged
+                # and re-counted against the sender.
+                st = self.get_state()
+                vals = st.validators
+                min_height = st.last_block_height - ledger.cfg.stale_height_slack
+                kept = []
+                tr = self.tracer
+                for wk, vote in ingest:
+                    if not vals.has_address(vote.validator_address):
+                        n_unknown += 1
+                    elif vote.height < min_height:
+                        n_stale += 1
+                    else:
+                        kept.append((wk, vote))
+                        continue
+                    if tr.active and tr.sampled(vote.tx_hash):
+                        t = monotonic()
+                        tr.span(vote.tx_hash, SPAN_PRE_DROP, t, t)
+                ingest = kept
             if ingest:
                 # one pool lock for the whole frame (check_tx_many);
                 # full/too-large rejections drop the vote like the
@@ -256,6 +314,17 @@ class TxVoteReactor(Reactor):
                         seen.put(wk, (vote.vote_key(), vote))
                     if err is not None and isinstance(err, ErrTxInCache):
                         peer.stats.duplicates += 1
+            if ledger is not None and (
+                ingest or n_unknown or n_stale or n_replayed
+            ):
+                drops = {}
+                if n_unknown:
+                    drops["unknown_validator"] = n_unknown
+                if n_stale:
+                    drops["stale_height"] = n_stale
+                if n_replayed:
+                    drops["replayed_sig"] = n_replayed
+                ledger.note_frame(peer.node_id, len(ingest), drops or None)
         elif msg_type == MSG_HEIGHT:
             height, _ = amino.read_uvarint(msg, 1)
             peer.set(PEER_HEIGHT_KEY, height)

@@ -113,6 +113,7 @@ class SyncManager:
         scoreboard=None,  # PeerScoreBoard | None (health off -> None)
         metrics=None,  # SyncMetrics | None
         tracer=None,
+        ledger=None,  # health.byzantine.ByzantineLedger | None
     ):
         self.chain_id = chain_id
         self.tx_store = tx_store
@@ -123,6 +124,12 @@ class SyncManager:
         self.scoreboard = scoreboard
         self.metrics = metrics
         self.tracer = tracer or NULL_TRACER
+        # unified Byzantine ledger (health/byzantine.py): the sync
+        # client's private ban + advert bookkeeping stays here (it
+        # gates SERVER selection), but the strike itself is recorded on
+        # the node-wide ledger, which also quarantines the liar's VOTE
+        # traffic — one /health section, one metrics family
+        self.ledger = ledger
         self._rng = random.Random(self.config.seed)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -343,6 +350,8 @@ class SyncManager:
                 # reflects only peers we would actually fetch from (it
                 # re-adverts on the next status tick if still connected)
                 self._adverts.pop(peer.node_id, None)
+            if self.ledger is not None:
+                self.ledger.note_sync_strike(peer.node_id)
             if self.scoreboard is not None:
                 self.scoreboard.punish(peer.node_id, cfg.byzantine_penalty)
         else:

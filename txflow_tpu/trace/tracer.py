@@ -39,6 +39,11 @@ SPAN_TX_INGEST = "mempool_ingest"
 SPAN_GOSSIP_INGEST = "gossip_ingest"
 SPAN_SIGN = "sign_walk"
 SPAN_VOTE_INGEST = "vote_ingest"
+# accountable gossip (health/byzantine.py): a vote rejected by the O(1)
+# ingest pre-checks (unknown validator / stale height) — zero-length
+# marker at the drop instant, so a trace shows WHERE hostile traffic
+# died relative to the honest pipeline
+SPAN_PRE_DROP = "pre_verify_drop"
 SPAN_LOCK_WAIT = "lock_wait"
 SPAN_LINGER = "linger"
 # per-lane coalescer holds (ISSUE 12 verify lanes): the engine's bulk
@@ -64,7 +69,7 @@ SPAN_E2E = "e2e"
 
 SPAN_ORDER = (
     SPAN_ADMISSION, SPAN_TX_INGEST, SPAN_GOSSIP_INGEST, SPAN_SIGN,
-    SPAN_VOTE_INGEST, SPAN_LOCK_WAIT, SPAN_LINGER, SPAN_LINGER_PRIO,
+    SPAN_VOTE_INGEST, SPAN_PRE_DROP, SPAN_LOCK_WAIT, SPAN_LINGER, SPAN_LINGER_PRIO,
     SPAN_LINGER_BULK, SPAN_PREP, SPAN_DEVICE, SPAN_QUORUM, SPAN_SPEC,
     SPAN_COMMIT, SPAN_SYNC_FETCH, SPAN_SYNC_VERIFY, SPAN_SYNC_APPLY,
     SPAN_E2E,

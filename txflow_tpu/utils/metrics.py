@@ -396,6 +396,34 @@ class SyncMetrics:
         self.served_txs = r.counter("sync", "served_txs", "committed txs this node served to catching-up peers")
 
 
+class ByzantineMetrics:
+    """Accountable vote gossip (health/byzantine.py, ``txflow_byzantine_*``).
+
+    Strikes and quarantines are the unified ledger's totals across BOTH
+    sources (gossip verdict attribution and sync-server forgery); the
+    ``drop_*`` counters break out the O(1) ingest pre-checks so an
+    operator can see WHAT a flooding peer was sending without reading
+    per-peer /health detail. The Registry has no label support — one
+    counter per drop reason, keyed in ``drop_counters`` for the ledger."""
+
+    def __init__(self, registry: "Registry | None" = None):
+        r = registry or GLOBAL
+        self.strikes = r.counter("byzantine", "strikes", "misbehavior strikes recorded against peers (gossip + sync)")
+        self.quarantines = r.counter("byzantine", "quarantines", "peer vote-traffic quarantines (circuit breaker trips)")
+        self.invalid_votes = r.counter("byzantine", "invalid_votes", "device valid=False verdicts attributed to a relaying peer")
+        self.drop_unknown_validator = r.counter("byzantine", "drop_unknown_validator", "votes dropped pre-verify: signer not in the validator set")
+        self.drop_stale_height = r.counter("byzantine", "drop_stale_height", "votes dropped pre-verify: height behind the stale slack")
+        self.drop_replayed_sig = r.counter("byzantine", "drop_replayed_sig", "votes dropped pre-verify: same peer re-sent an identical signature")
+        self.drop_quarantined = r.counter("byzantine", "drop_quarantined", "vote segments dropped whole-frame from quarantined peers")
+        self.quarantined_peers = r.gauge("byzantine", "quarantined_peers", "peers currently under vote-traffic quarantine")
+        self.drop_counters = {
+            "unknown_validator": self.drop_unknown_validator,
+            "stale_height": self.drop_stale_height,
+            "replayed_sig": self.drop_replayed_sig,
+            "quarantined": self.drop_quarantined,
+        }
+
+
 class TxFlowMetrics:
     """Fast-path metrics (reference txflowstate/metrics.go:17-45)."""
 
