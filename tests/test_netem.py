@@ -402,3 +402,12 @@ def test_wan_matrix_smoke_gate():
     )
     assert proc.returncode == 0, f"\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
     assert "SOAK OK (wan-matrix)" in proc.stdout
+    # the machine-readable contract (scenario/harness.py): exactly one
+    # final RESULT JSON line, exit code 0 <=> ok
+    import json as _json
+
+    last = [l for l in proc.stdout.strip().splitlines() if l][-1]
+    assert last.startswith("RESULT "), proc.stdout
+    payload = _json.loads(last[len("RESULT "):])
+    assert payload["ok"] is True and payload["breach"] is None
+    assert payload["mode"] == "wan-matrix" and len(payload["scenarios"]) >= 5

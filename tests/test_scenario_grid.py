@@ -1,0 +1,418 @@
+"""Scenario-grid tests (ISSUE-16): spec determinism + PRNG-domain
+disjointness under composition, the harness breach/exit-code contract,
+results-matrix banking under clean-supersede, the CLI preview flags, and
+the tier-1 live smoke gate over real-TCP ProcNets.
+
+The load-bearing property here is the composition rule from
+scenario/spec.py: every axis draws from its OWN sha256-scoped PRNG
+domain, so toggling one axis's level leaves every other axis's drawn
+schedule byte-identical. That is what makes a grid walk DIAGNOSABLE —
+a red tile differs from its green neighbor in exactly one axis's
+schedule, never in collateral re-draws.
+"""
+
+import conftest  # noqa: F401
+
+import json
+import os
+import subprocess
+import sys
+from dataclasses import replace
+
+import pytest
+
+from txflow_tpu.scenario import bank
+from txflow_tpu.scenario import harness as H
+from txflow_tpu.scenario.spec import (
+    AXES,
+    GridSpec,
+    TileSpec,
+    axis_seed,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _sched_json(plan):
+    """The byte-stability handle: one canonical string per axis."""
+    return {
+        axis: json.dumps(sched, sort_keys=True)
+        for axis, sched in plan.schedules().items()
+    }
+
+
+# -- axis PRNG domains ------------------------------------------------------
+
+
+def test_axis_seed_domains_all_disjoint():
+    """No two (seed, axis, level) triples may share a stream seed — the
+    foundation of the byte-stability contract."""
+    seeds = {}
+    for grid_seed in (0, 1, 7):
+        for axis, levels in AXES.items():
+            for level in levels:
+                s = axis_seed(grid_seed, axis, level)
+                assert s not in seeds.values(), (grid_seed, axis, level)
+                seeds[(grid_seed, axis, level)] = s
+    # and the derivation is stable (pure function of its inputs)
+    assert axis_seed(7, "weather", "lan") == axis_seed(7, "weather", "lan")
+
+
+def test_materialize_is_deterministic():
+    """Same seed, same tile => byte-identical schedules, across fresh
+    GridSpec instances (no hidden shared-RNG state)."""
+    for tile in GridSpec(seed=7).smoke_diagonal():
+        a = _sched_json(GridSpec(seed=7).materialize(tile))
+        b = _sched_json(GridSpec(seed=7).materialize(tile))
+        assert a == b, tile.tile_id
+
+
+def test_toggling_one_axis_leaves_others_byte_stable():
+    """THE composition property: for a fixed seed, changing one axis's
+    level re-draws only that axis's schedule — the other three are
+    json-byte-identical. Checked from a fully-composed base tile across
+    every alternate level of every axis."""
+    grid = GridSpec(seed=3)
+    base_tile = TileSpec(
+        adversary="fleet",
+        weather="lossy-edge",
+        overload="flood",
+        stake="churning",
+        seed=3,
+    )
+    base = _sched_json(grid.materialize(base_tile))
+    for axis, levels in AXES.items():
+        for level in levels:
+            if level == base_tile.level(axis):
+                continue
+            variant_tile = replace(base_tile, **{axis: level})
+            variant = _sched_json(grid.materialize(variant_tile))
+            for other in AXES:
+                if other == axis:
+                    continue
+                assert variant[other] == base[other], (
+                    f"toggling {axis} -> {level} re-drew the {other} "
+                    f"schedule"
+                )
+
+
+def test_seed_scopes_every_drawing_axis():
+    """A different grid seed must re-draw the drawn parts of every axis
+    (constants like budget tables may coincide; drawn values may not)."""
+    tile0 = TileSpec(
+        adversary="fleet", weather="flapping", overload="flood",
+        stake="churning", seed=0,
+    )
+    tile1 = replace(tile0, seed=1)
+    p0 = GridSpec(seed=0).materialize(tile0)
+    p1 = GridSpec(seed=1).materialize(tile1)
+    assert p0.adversary["drivers"] != p1.adversary["drivers"]
+    assert p0.weather["shaper_seed"] != p1.weather["shaper_seed"]
+    assert p0.overload["intervals"] != p1.overload["intervals"]
+    assert p0.stake["churn"] != p1.stake["churn"]
+
+
+# -- spec validation + tile enumeration ------------------------------------
+
+
+def test_tile_and_grid_validation():
+    with pytest.raises(ValueError):
+        TileSpec(adversary="bogus")
+    with pytest.raises(ValueError):
+        TileSpec(weather="dial-up")
+    with pytest.raises(ValueError):
+        GridSpec(n_validators=3)  # adversary tiles need honest quorum
+    with pytest.raises(ValueError):
+        GridSpec.from_dict({"axes": {"tides": ["high"]}})
+    with pytest.raises(ValueError):
+        GridSpec.from_dict({"axes": {"weather": ["lan", "dial-up"]}})
+    with pytest.raises(ValueError):
+        GridSpec.from_dict({"axes": {"overload": []}})
+
+
+def test_smoke_diagonal_covers_every_level():
+    grid = GridSpec(seed=5)
+    tiles = grid.smoke_diagonal()
+    assert len(tiles) == max(len(ls) for ls in AXES.values())
+    for axis, levels in AXES.items():
+        assert {t.level(axis) for t in tiles} == set(levels)
+    # the acceptance tile: all four axes off-baseline at once
+    assert any(t.composed for t in tiles)
+    assert all(t.seed == 5 for t in tiles)
+    assert len({t.tile_id for t in tiles}) == len(tiles)
+
+
+def test_full_tiles_is_the_configured_cross_product():
+    grid = GridSpec()
+    want = 1
+    for levels in AXES.values():
+        want *= len(levels)
+    tiles = grid.full_tiles()
+    assert len(tiles) == want
+    assert len({t.tile_id for t in tiles}) == want
+    # a spec file restricting axes walks the restricted product
+    small = GridSpec.from_dict(
+        {"axes": {"weather": ["lan", "congested"], "stake": ["uniform"]}}
+    )
+    assert len(small.full_tiles()) == (
+        len(AXES["adversary"]) * 2 * len(AXES["overload"]) * 1
+    )
+
+
+def test_tile_plan_derived_facts():
+    grid = GridSpec(seed=2)
+    quiet = grid.materialize(TileSpec(seed=2))
+    assert quiet.adversary_index is None
+    assert quiet.consensus is False
+    assert quiet.budget_scale == 1.0
+    assert quiet.net_signature == ("stake", "uniform")
+
+    hot = grid.materialize(
+        TileSpec(
+            adversary="flooder", weather="congested", overload="flood",
+            stake="churning", seed=2,
+        )
+    )
+    powers = hot.stake["powers"]
+    # the adversary slot is the smallest stake: quarantining it must
+    # never cost the honest side its 2n/3
+    assert hot.adversary_index == powers.index(min(powers))
+    assert hot.consensus is True  # churn rides the block path
+    assert hot.budget_scale > 1.0
+    # churn never re-weights the (potential) adversary slot
+    for ev in hot.stake["churn"]:
+        assert ev["validator"] != hot.adversary_index
+
+
+# -- harness: breach classes, exit codes, RESULT line ----------------------
+
+
+def test_exit_code_contract():
+    assert H.EXIT_CODES == {
+        "infra": 1, "loss": 10, "divergence": 11,
+        "slo": 12, "adversary": 13, "liveness": 14,
+    }
+    assert set(H.BREACH_CLASSES) == set(H.EXIT_CODES)
+    assert H.worst_breach(["slo", "loss", "liveness"]) == "loss"
+    assert H.worst_breach(["slo", "infra"]) == "slo"
+    assert H.worst_breach([]) is None
+    with pytest.raises(ValueError):
+        H.Breach("meteor", "not a class")
+
+
+def _last_result_line(out: str) -> dict:
+    lines = [l for l in out.strip().splitlines() if l]
+    assert lines[-1].startswith("RESULT "), out
+    return json.loads(lines[-1][len("RESULT "):])
+
+
+def test_emit_result_line_shape(capsys):
+    code = H.emit_result("unit", False, "slo", "too slow", p50_ms=900)
+    assert code == 12
+    payload = _last_result_line(capsys.readouterr().out)
+    assert payload == {
+        "mode": "unit", "ok": False, "exit_code": 12, "breach": "slo",
+        "detail": "too slow", "p50_ms": 900,
+    }
+    assert H.emit_result("unit", True, probes=3) == 0
+    payload = _last_result_line(capsys.readouterr().out)
+    assert payload["ok"] is True and payload["breach"] is None
+
+
+def test_run_mode_maps_breaches_to_exit_codes(capsys):
+    with pytest.raises(SystemExit) as e:
+        H.run_mode("unit", lambda: {"probes": 9})
+    assert e.value.code == 0
+    assert _last_result_line(capsys.readouterr().out)["probes"] == 9
+
+    def lose():
+        raise H.Breach("loss", "a tx went missing")
+
+    with pytest.raises(SystemExit) as e:
+        H.run_mode("unit", lose)
+    assert e.value.code == 10
+    out = capsys.readouterr().out
+    assert "SOAK STALL" in out
+    assert _last_result_line(out)["breach"] == "loss"
+
+    def crash():
+        raise RuntimeError("socket fell over")
+
+    with pytest.raises(SystemExit) as e:
+        H.run_mode("unit", crash)
+    assert e.value.code == 1
+    assert _last_result_line(capsys.readouterr().out)["breach"] == "infra"
+
+
+# -- banking: fingerprints + clean-supersede -------------------------------
+
+
+def _verdict(tile, ok, breach=None):
+    return {"tile": tile, "pass": ok, "breach": breach, "detail": ""}
+
+
+def test_verdict_fingerprint_pins_identity():
+    verdicts = [_verdict("a", True), _verdict("b", False, "slo")]
+    fp = bank.verdict_fingerprint(verdicts)
+    assert fp == bank.verdict_fingerprint([dict(v) for v in verdicts])
+    # order, verdicts and breach classes are all identity
+    assert fp != bank.verdict_fingerprint(list(reversed(verdicts)))
+    assert fp != bank.verdict_fingerprint(
+        [_verdict("a", True), _verdict("b", True)]
+    )
+    assert fp != bank.verdict_fingerprint(
+        [_verdict("a", True), _verdict("b", False, "loss")]
+    )
+
+
+def test_matrix_clean_semantics():
+    grid = GridSpec()
+    red = bank.build_matrix(grid, "smoke-diagonal", [_verdict("a", False, "slo")])
+    assert bank.matrix_clean(red)  # red tiles are data, not dirt
+    assert not bank.matrix_clean(
+        bank.build_matrix(grid, "smoke-diagonal", [_verdict("a", False, "infra")])
+    )
+    assert not bank.matrix_clean(bank.build_matrix(grid, "smoke-diagonal", []))
+    assert not bank.matrix_clean(
+        bank.build_matrix(grid, "smoke-diagonal", [_verdict("a", True)], error="boom")
+    )
+
+
+def test_bank_clean_supersede(tmp_path):
+    path = str(tmp_path / "grid.json")
+    grid = GridSpec()
+    clean_a = bank.build_matrix(grid, "smoke-diagonal", [_verdict("a", True)])
+    assert bank.bank_matrix(clean_a, path)
+    banked = bank.load_banked(path)
+    assert banked["clean"] is True and banked["passed"] == 1
+
+    # a dirty run must never displace the clean bank
+    dirty = bank.build_matrix(
+        grid, "smoke-diagonal", [_verdict("a", False, "infra")]
+    )
+    assert not bank.bank_matrix(dirty, path)
+    assert bank.load_banked(path)["verdict_fingerprint"] == (
+        clean_a["verdict_fingerprint"]
+    )
+
+    # a clean run with RED tiles still supersedes: regressions must be
+    # allowed to update the reference they will be blamed against
+    clean_red = bank.build_matrix(
+        grid, "smoke-diagonal", [_verdict("a", False, "slo")]
+    )
+    assert bank.bank_matrix(clean_red, path)
+    assert bank.load_banked(path)["failed"] == 1
+
+
+# -- CLI preview flags (no nets) -------------------------------------------
+
+
+def _run_grid_cli(*argv, timeout=60):
+    return subprocess.run(
+        [sys.executable, "tools/scenario_grid.py", *argv],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+
+
+def test_grid_cli_list():
+    proc = _run_grid_cli("--smoke", "--list")
+    assert proc.returncode == 0, proc.stderr
+    lines = proc.stdout.strip().splitlines()
+    assert lines[0].startswith("smoke-diagonal: 5 tiles")
+    tile_lines = [l for l in lines[1:] if "adv=" in l]
+    assert len(tile_lines) == 5
+    assert any("[composed]" in l for l in tile_lines)
+
+
+def test_grid_cli_dry_run_schedules():
+    proc = _run_grid_cli("--smoke", "--dry-run", "--only", "adv=fleet")
+    assert proc.returncode == 0, proc.stderr
+    body = proc.stdout
+    start = body.index("{")
+    plan = json.loads(body[start:])
+    assert set(plan["schedules"]) == set(AXES)
+    kinds = [d["kind"] for d in plan["schedules"]["adversary"]["drivers"]]
+    assert kinds == ["sig-garbage", "unknown-signer", "replayer"]
+    assert plan["adversary_index"] is not None
+
+
+def test_grid_cli_empty_filter_is_infra():
+    proc = _run_grid_cli("--smoke", "--only", "adv=nonesuch")
+    assert proc.returncode == 1
+    payload = _last_result_line(proc.stdout)
+    assert payload["breach"] == "infra" and payload["ok"] is False
+
+
+# -- tier-1 live gate: one real-TCP tile through the full runner path ------
+
+
+def test_scenario_grid_smoke_gate(tmp_path):
+    """tools/scenario_grid.py --smoke --only <baseline tile> end to end:
+    a 4-process real-TCP net judged on zero admitted-tx loss, cross-node
+    committed-set equality, prefix stability and the weather-profile SLO
+    — banked under clean-supersede, exit 0, one final RESULT line. (The
+    full 5-tile diagonal incl. adversary/churn tiles is the slow-marked
+    test below; this keeps the live gate inside the tier-1 budget.)"""
+    out = str(tmp_path / "matrix.json")
+    proc = _run_grid_cli(
+        "--smoke", "--only", "adv=none|wan=lan", "--out", out, timeout=110
+    )
+    assert proc.returncode == 0, (
+        f"\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "SOAK OK (scenario-grid)" in proc.stdout
+    payload = _last_result_line(proc.stdout)
+    assert payload["ok"] is True and payload["tiles"] == 1
+    assert payload["banked"] is True
+    matrix = bank.load_banked(out)
+    assert matrix["clean"] is True and matrix["passed"] == 1
+    assert matrix["verdict_fingerprint"] == payload["fingerprint"]
+
+
+@pytest.mark.slow
+def test_scenario_grid_smoke_diagonal_reproducible(tmp_path):
+    """The acceptance check, live: the full smoke diagonal twice under
+    one seed — 5/5 green both times, identical verdict fingerprints."""
+    fingerprints = []
+    for run in ("a", "b"):
+        out = str(tmp_path / f"matrix-{run}.json")
+        proc = _run_grid_cli("--smoke", "--out", out, timeout=900)
+        assert proc.returncode == 0, (
+            f"run {run}:\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+        )
+        payload = _last_result_line(proc.stdout)
+        assert payload["tiles"] == 5 and payload["passed"] == 5
+        fingerprints.append(payload["fingerprint"])
+    assert fingerprints[0] == fingerprints[1]
+
+
+@pytest.mark.slow
+def test_scenario_grid_full_cross_product_restricted(tmp_path):
+    """--full walks the configured cross-product (offline-soak knobs).
+    Restricted to 2x2 adversary x overload on one stake table so the
+    whole product shares a single bring-up."""
+    spec = {
+        "seed": 11,
+        "axes": {
+            "adversary": ["none", "flooder"],
+            "weather": ["lan"],
+            "overload": ["none", "surge"],
+            "stake": ["uniform"],
+        },
+    }
+    spec_path = tmp_path / "grid.json"
+    spec_path.write_text(json.dumps(spec))
+    out = str(tmp_path / "matrix.json")
+    proc = _run_grid_cli(
+        "--full", "--spec", str(spec_path), "--out", out, timeout=1800
+    )
+    assert proc.returncode == 0, (
+        f"\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    payload = _last_result_line(proc.stdout)
+    assert payload["tiles"] == 4 and payload["passed"] == 4
+    assert payload["kind"] == "full"

@@ -1,20 +1,26 @@
-"""Churn soak: LocalNet under continuous load + byzantine injections +
-partition/heal cycles, asserting convergence at quiescence.
+"""Soak modes: churn, overload, byzantine, and the WAN weather matrix.
 
-Dev tool (not part of the test suite — wall-clock minutes): exercises the
-full stack the way a flaky validator set would — fast path + block
-ticker, hostile votes (bad sig, unknown validator, oversized fields),
-repeated partitions and heals — then checks for forks, stalls, and leaks.
+Dev tool (not part of the test suite — wall-clock minutes): every mode
+exercises the full stack the way production weather would, judges
+through the shared assertion core in ``txflow_tpu/scenario/harness.py``,
+and ends with exactly one machine-readable ``RESULT {...}`` JSON line
+plus a breach-class exit code (0 ok / 1 infra / 10 loss / 11 divergence
+/ 12 slo / 13 adversary / 14 liveness — see the harness module
+docstring). The human ``SOAK OK (mode)`` / ``SOAK STALL`` banners stay,
+but scripts should match the RESULT line and the exit code, not grep
+banner text.
+
 Usage: JAX_PLATFORMS=cpu python tools/soak.py [seconds] [--rotate] [--restart]
                                               [--smoke] [--overload]
                                               [--wan-matrix] [--byzantine]
+
+default (churn): LocalNet under continuous load + hostile vote
+injections + partition/heal cycles, asserting convergence at quiescence.
 --restart periodically stops one durable node, rebuilds it over its
 artifacts (fresh app, handshake replay + catchup), and reconnects it —
 the restart x partition x load interleaving that exposed the r5
-replay-deferral bug.
---smoke: CI-sized run — ~10s of churn with tight quiescence deadlines,
-exiting nonzero with a SOAK STALL banner if convergence misses them;
-wire it into a pipeline as a cheap liveness canary.
+replay-deferral bug. --rotate adds live validator re-weights.
+--smoke: CI-sized run with tight quiescence deadlines.
 --overload: the ISSUE-6 front-door soak — a 4-node MULTI-PROCESS net over
 real TCP (node.procnet), offered load far past pool capacity with chaos
 faults active and one node black-holing its gossip mid-run. Asserts the
@@ -24,23 +30,21 @@ evicted peers heal via the address-book re-dial, and shed traffic is
 visible in txflow_admission_* metrics. Mid-flood, one durable node is
 SIGKILLed, its data dir DELETED, and restarted empty: it must recover
 the committed set from peers via catch-up sync (txflow_sync_* metrics,
-/health sync section settling back to idle/lag 0) with zero
-admitted-tx loss — the ISSUE-9 wipe-revive-rejoin drill. Also records a cross-node trace
-of the run (merged Chrome-trace JSON, SOAK_TRACE_OUT to choose the
-path) and asserts ZERO leaked/unclosed trace spans post-quiescence via
-each node's /health trace digest. Exits 1 with a SOAK STALL banner on
-any breach; --overload --smoke is tier-1-budget sized.
---byzantine: the ISSUE-14 accountable-gossip soak — a 4-node LocalNet
-with one validator turned Byzantine (fast-path signer disarmed, its
-switch flooding garbage-signature / stale / forged-address votes) plus
-a malicious non-validator peer (unknown-signer floods + identical-vote
-replays), breakers armed at production-shaped thresholds from t=0,
-under continuous honest load. Asserts zero admitted-tx loss, every
-adversary struck AND quarantined on every honest node, the front-door
-gate absorbing the still-running flood (quarantined drops growing),
-and a post-quarantine waste bound: < 5% of subsequently device-
-dispatched votes invalid. Exits 1 with a SOAK STALL banner on any
-breach; --byzantine --smoke is CI-sized.
+/health sync section settling back to idle/lag 0) with zero admitted-tx
+loss — the ISSUE-9 wipe-revive-rejoin drill. Also records a cross-node
+trace (merged Chrome-trace JSON, SOAK_TRACE_OUT to choose the path) and
+asserts ZERO leaked trace spans post-quiescence. --overload --smoke is
+tier-1-budget sized.
+--byzantine: the ISSUE-14 accountable-gossip soak, now over REAL TCP —
+a 4-process net with consensus on and one validator turned adversary
+(fast-path signer disarmed, its switch flooding garbage-signature /
+stale / unknown-signer votes plus identical-vote replays), breakers
+armed at production-shaped thresholds from t=0. Asserts the adversary
+is struck AND quarantined on every honest node, zero admitted-tx loss
+under the flood, the front-door gate absorbing the still-running flood
+(quarantined drops growing), and a post-quarantine waste bound: < 5%
+of subsequently device-dispatched votes invalid. --byzantine --smoke is
+CI-sized.
 --wan-matrix: the ISSUE-11 network-weather matrix — a 3-node multi-
 process net over real TCP with every link WAN-shaped (netem/) and the
 adaptive peer transport on, walked live through the named weather
@@ -50,6 +54,10 @@ prefix stability, cross-node committed-set equality, and the profile's
 p50/p99 commit budgets; then that the mesh heals to full connectivity
 on calm weather with a bounded number of re-dials. See wan_matrix_main
 for the SOAK_WAN_* / SOAK_MATRIX_OUT knobs.
+
+The composed cross-product of these axes (adversary x weather x
+overload x stake churn) lives in ``tools/scenario_grid.py``, which
+judges through the same harness.
 """
 
 import os
@@ -63,28 +71,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import hashlib
 
-from txflow_tpu.node import LocalNet
-from txflow_tpu.node.node import Node, NodeConfig
-from txflow_tpu.p2p import connect_switches
-from txflow_tpu.store.db import FileDB
-from txflow_tpu.types import TxVote
-from txflow_tpu.types.priv_validator import MockPV
-from txflow_tpu.utils.config import test_config
+from txflow_tpu.scenario import harness as H
 
 
-def overload_main(smoke: bool) -> None:
+def overload_main(smoke: bool) -> dict:
     """Real-socket overload soak (see module docstring, --overload)."""
     import http.client
     import json
     import statistics
     import threading
-    import urllib.request
 
-    from txflow_tpu.node.procnet import ProcNet
-
-    def stall(msg: str) -> None:
-        print(f"SOAK STALL: {msg}", flush=True)
-        sys.exit(1)
+    from txflow_tpu.admission import soak_spec_overrides
 
     overload_secs = 10.0 if smoke else 45.0
     # SOAK_COMMIT_WAIT: like SOAK_P50_BUDGET_MS, a relief valve for
@@ -96,96 +93,60 @@ def overload_main(smoke: bool) -> None:
     )
     n = 4  # 3-of-4 quorum: commits keep flowing while node 0 black-holes
     wipe_root = tempfile.mkdtemp(prefix="soak-wipe-")
-    net = ProcNet(
-        n,
-        spec={
-            "chain_id": "txflow-soak",
-            "seed_prefix": "soak-ov",
-            # small pool => the flood hits high water in seconds
-            "mempool": {"size": 300, "cache_size": 20000},
-            # scalar (host) verify has NO batching amortization — a big
-            # batch only adds head-of-line blocking (a bulk batch in
-            # flight holds the engine for batch*~5ms, scaled by the 4-way
-            # CPU contention). Small steps keep the wait for "the step
-            # after this one" — where the priority drain puts a fresh
-            # probe's votes — in the tens of milliseconds.
-            "engine": {"max_batch": 8, "min_batch": 1},
-            # bulk_rate: the box runs 4 nodes on shared cores with the
-            # scalar (host) verifier at ~5 ms/signature — pipeline
-            # capacity is ~10-15 tx/s TOTAL. Capping bulk admits per
-            # node keeps the system inside its latency headroom (the
-            # whole point of admission control) while the flood sheds.
-            "admission": {
-                "retry_after": 0.25,
-                "pressure_interval": 0.02,
-                # admit rate must hold the system in EQUILIBRIUM: with
-                # the flood stealing CPU, commit capacity is a few tx/s
-                # system-wide. Admitting faster than committing grows the
-                # pending backlog (sign walks + regossip re-walks scale
-                # with it), and probe latency degrades minute over
-                # minute. 1/s per RPC node keeps the backlog flat.
-                "bulk_rate": 1.0,
-                "bulk_burst": 2.0,
-            },
-            # aggressive scoring posture: the 2.5s blackhole window must
-            # produce at least one eviction + address-book re-dial
-            "health": {
-                "score_max": 1.0,
-                "score_floor": -2.0,
-                "stale_after": 0.5,
-                "min_sends_for_stale": 2,
-                "reconnect_base": 0.1,
-            },
-            # LAN-ish chaos: 2% loss, ~20-40ms jittered delay per hop.
-            # (A tx->votes->quorum round is several hops, so per-hop
-            # delay compounds straight into the probe p50.)
-            "fault": {"drop": 0.02, "delay": 0.02, "delay_max": 0.02, "seed": 7},
-            "regossip": 0.2,
-            # dense sampling so the recorded trace has real content at
-            # this run's small tx counts (default 1/64 would be sparse)
-            "trace": {"sample_rate": 4},
-            # node 0 black-holes its OUTBOUND gossip mid-overload: its
-            # peers see sends-without-progress, evict it by score, and
-            # heal through the book re-dial (dials bypass chaos)
-            # node 3 runs durable stores so the wipe-revive-rejoin phase
-            # can SIGKILL it mid-flood, delete its data dir, and make it
-            # recover the committed set from peers via catch-up sync
-            "per_node": {
-                0: {"blackhole": {"start": 3.0, "duration": 2.5}},
-                3: {"data_dir": f"{wipe_root}/node3"},
-            },
+    spec = {
+        "chain_id": "txflow-soak",
+        "seed_prefix": "soak-ov",
+        # small pool => the flood hits high water in seconds
+        "mempool": {"size": 300, "cache_size": 20000},
+        # scalar (host) verify has NO batching amortization — a big
+        # batch only adds head-of-line blocking. Small steps keep the
+        # wait for "the step after this one" — where the priority drain
+        # puts a fresh probe's votes — in the tens of milliseconds.
+        "engine": {"max_batch": 8, "min_batch": 1},
+        # soak admission posture (shared with the scenario grid): paced
+        # bulk admits + a pinned bulk_rate_floor so the adaptive
+        # commit-rate path can't un-cap the soak box — see
+        # admission/config.py soak_spec_overrides
+        "admission": soak_spec_overrides(),
+        # aggressive scoring posture: the 2.5s blackhole window must
+        # produce at least one eviction + address-book re-dial
+        "health": {
+            "score_max": 1.0,
+            "score_floor": -2.0,
+            "stale_after": 0.5,
+            "min_sends_for_stale": 2,
+            "reconnect_base": 0.1,
         },
-    )
+        # LAN-ish chaos: 2% loss, ~20-40ms jittered delay per hop
+        "fault": {"drop": 0.02, "delay": 0.02, "delay_max": 0.02, "seed": 7},
+        "regossip": 0.2,
+        # dense sampling so the recorded trace has real content at this
+        # run's small tx counts (default 1/64 would be sparse)
+        "trace": {"sample_rate": 4},
+        # node 0 black-holes its OUTBOUND gossip mid-overload; node 3
+        # runs durable stores so the wipe-revive-rejoin phase can
+        # SIGKILL it mid-flood, delete its data dir, and make it
+        # recover the committed set from peers via catch-up sync
+        "per_node": {
+            0: {"blackhole": {"start": 3.0, "duration": 2.5}},
+            3: {"data_dir": f"{wipe_root}/node3"},
+        },
+    }
     print(f"overload soak: starting {n}-process net ...", flush=True)
-    net.start()
-    try:
+    with H.live_net(n, spec) as net:
         # RPC targets for floods + probes: node 0 black-holes, node 3
         # gets wiped mid-flood — neither may carry client traffic
         live = [1, 2]
 
-        def commit_latency(
-            i: int, tx: str, timeout: float = 10.0
-        ) -> tuple[float | None, str]:
-            """Submit via broadcast_tx_commit; (seconds-to-commit or None,
-            tx hash). None means slow, not necessarily lost: the caller
-            re-checks the hash post-quiescence before calling it loss."""
-            host, port = net.rpc_addr(i)
-            t0 = time.monotonic()
-            with urllib.request.urlopen(
-                f'http://{host}:{port}/broadcast_tx_commit?tx="{tx}"'
-                f"&timeout={timeout}",
-                timeout=timeout + 5,
-            ) as r:
-                res = json.loads(r.read().decode())["result"]
-            lat = time.monotonic() - t0 if res.get("committed") else None
-            return lat, res["hash"]
-
         # -- phase 1: unloaded priority baseline --
         base_lat = []
         for i in range(8):
-            lat, _ = commit_latency(live[i % len(live)], f"fee=1;base-{i}=v")
+            lat, _ = H.commit_latency(net, live[i % len(live)], f"fee=1;base-{i}=v")
             if lat is None:
-                stall(f"baseline priority tx {i} failed to commit unloaded")
+                raise H.Breach(
+                    "liveness",
+                    f"baseline priority tx {i} failed to commit unloaded",
+                )
             base_lat.append(lat)
         p50_base = statistics.median(base_lat)
         print(f"baseline priority p50 {p50_base * 1e3:.0f}ms", flush=True)
@@ -235,8 +196,8 @@ def overload_main(smoke: bool) -> None:
         slow_probes: list[str] = []  # timed out in-flight; re-checked below
         probe_i = 0
         while time.monotonic() - t_flood < overload_secs:
-            lat, h = commit_latency(
-                live[probe_i % len(live)], f"fee=1;probe-{probe_i}=v",
+            lat, h = H.commit_latency(
+                net, live[probe_i % len(live)], f"fee=1;probe-{probe_i}=v",
                 timeout=probe_timeout,
             )
             if lat is None:
@@ -277,7 +238,9 @@ def overload_main(smoke: bool) -> None:
 
         # -- SLO assertions --
         if not over_lat:
-            stall("no priority probes completed under overload")
+            raise H.Breach(
+                "liveness", "no priority probes completed under overload"
+            )
         p50_over = statistics.median(over_lat)
         # SOAK_P50_BUDGET_MS: absolute floor for heavily-shared boxes
         # where 4 processes on contended cores can't hold the 2x-baseline
@@ -290,87 +253,75 @@ def overload_main(smoke: bool) -> None:
             flush=True,
         )
         if p50_over > budget:
-            stall(
+            raise H.Breach(
+                "slo",
                 f"priority p50 {p50_over * 1e3:.0f}ms breached the "
-                f"{budget * 1e3:.0f}ms budget"
+                f"{budget * 1e3:.0f}ms budget",
             )
         if n_shed == 0:
-            stall("flood never saw a 429: the front door did not shed")
+            raise H.Breach(
+                "liveness", "flood never saw a 429: the front door did not shed"
+            )
         rej = sum(
             net.metrics_value(i, "txflow_admission_rejected_overload") or 0.0
             for i in range(n)
         )
         if rej <= 0:
-            stall("txflow_admission_rejected_overload stayed 0 on every node")
+            raise H.Breach(
+                "liveness",
+                "txflow_admission_rejected_overload stayed 0 on every node",
+            )
         reconnects = sum(
             net.rpc_json(i, "/health")["result"]["peers"]["reconnects"]
             for i in range(n)
         )
         if reconnects < 1:
-            stall("no evicted peer healed via the address-book re-dial")
+            raise H.Breach(
+                "liveness", "no evicted peer healed via the address-book re-dial"
+            )
 
-        # -- zero committed-tx loss: every ADMITTED tx must land — slow
-        # priority probes AND a bounded sample of admitted bulk hashes are
-        # checked post-quiescence --
+        # -- zero admitted-tx loss: every ADMITTED tx must land — slow
+        # priority probes AND a bounded sample of admitted bulk hashes
+        # are checked post-quiescence --
         sample = [h for a in admitted for h in a[:40] if h][:120]
-        deadline = time.monotonic() + commit_wait
-        remaining = set(sample) | set(slow_probes)
-        while remaining and time.monotonic() < deadline:
-            remaining = {
-                h
-                for h in remaining
-                if not net.rpc_json(1, f"/tx?hash={h}")["result"]["committed"]
-            }
-            if remaining:
-                time.sleep(0.5)
-        lost_probes = remaining & set(slow_probes)
-        if lost_probes:
-            stall(
-                f"{len(lost_probes)} priority probes never committed "
-                f"(priority-tx loss)"
-            )
-        if remaining:
-            stall(
-                f"{len(remaining)}/{len(sample)} admitted bulk txs never "
-                f"committed (admitted-tx loss)"
-            )
+        H.assert_all_committed(
+            net, set(sample) | set(slow_probes), [1], commit_wait,
+            what="admitted txs (priority probes + bulk sample)",
+        )
 
         # -- wipe drill convergence: node 3 restarted over an EMPTY data
         # dir and must have recovered the committed set from peers via
         # catch-up sync — same sample, checked on the wiped node itself,
         # plus the sync state machine settling back to idle/zero lag --
-        sync_deadline = time.monotonic() + commit_wait
-        wiped_remaining = set(sample) | set(slow_probes)
-        while wiped_remaining and time.monotonic() < sync_deadline:
-            wiped_remaining = {
-                h
-                for h in wiped_remaining
-                if not net.rpc_json(3, f"/tx?hash={h}")["result"]["committed"]
-            }
-            if wiped_remaining:
-                time.sleep(0.5)
-        if wiped_remaining:
-            stall(
-                f"wiped node 3 never recovered {len(wiped_remaining)} committed "
-                f"txs via sync (wipe-rejoin divergence)"
-            )
+        H.assert_all_committed(
+            net, set(sample) | set(slow_probes), [3], commit_wait,
+            what="wipe-rejoin recovery (wiped node 3)", kind="divergence",
+        )
         synced = net.metrics_value(3, "txflow_sync_txs_applied") or 0.0
         if synced <= 0:
-            stall("wiped node 3 reports zero txflow_sync_txs_applied")
+            raise H.Breach(
+                "liveness", "wiped node 3 reports zero txflow_sync_txs_applied"
+            )
         served = sum(
             net.metrics_value(i, "txflow_sync_served_txs") or 0.0
             for i in range(n - 1)
         )
         if served <= 0:
-            stall("no node served sync ranges during the wipe drill")
-        sync_state = {}
+            raise H.Breach(
+                "liveness", "no node served sync ranges during the wipe drill"
+            )
+        sync_state: dict = {}
+        sync_deadline = time.monotonic() + commit_wait
         while time.monotonic() < sync_deadline:
             sync_state = net.rpc_json(3, "/health")["result"].get("sync") or {}
             if sync_state.get("state") == "idle" and sync_state.get("lag", 1) == 0:
                 break
             time.sleep(0.5)
         else:
-            stall(f"node 3 sync never settled to idle/lag 0: {sync_state}")
+            raise H.Breach(
+                "liveness",
+                f"node 3 sync never settled to idle/lag 0: {sync_state}",
+            )
         print(
             f"wipe drill: node 3 recovered {synced:.0f} txs via sync "
             f"({served:.0f} served by peers), settled idle",
@@ -380,8 +331,7 @@ def overload_main(smoke: bool) -> None:
         # -- trace: record the run + assert zero leaked spans. Every
         # begin()'d span (device tickets, commit-queue residency) must
         # have closed once the flood quiesced — an open span here is a
-        # leak, the same class of proof as the drain-on-stop claim
-        # check. Polled briefly: a straggler commit apply may still be
+        # leak. Polled briefly: a straggler commit apply may still be
         # closing its span right at the quiescence edge. --
         leak_deadline = time.monotonic() + 15.0
         open_spans = []
@@ -395,7 +345,10 @@ def overload_main(smoke: bool) -> None:
             if all(o == 0 for o in open_spans):
                 break
             if time.monotonic() > leak_deadline:
-                stall(f"leaked trace spans after quiescence: {open_spans}")
+                raise H.Breach(
+                    "liveness",
+                    f"leaked trace spans after quiescence: {open_spans}",
+                )
             time.sleep(0.5)
         dumps = [net.rpc_json(i, "/trace")["result"] for i in range(n)]
         from txflow_tpu.trace.export import write_chrome_trace
@@ -420,181 +373,150 @@ def overload_main(smoke: bool) -> None:
             f"committed",
             flush=True,
         )
-    finally:
-        net.stop()
+        return {
+            "offered": n_offered,
+            "admitted": n_admitted,
+            "shed": n_shed,
+            "p50_base_ms": round(p50_base * 1e3, 1),
+            "p50_over_ms": round(p50_over * 1e3, 1),
+            "probes": probe_i,
+            "slow_probes": len(slow_probes),
+            "reconnects": int(reconnects),
+            "sync_applied": int(synced),
+            "trace_spans": n_spans,
+            "trace_out": trace_out,
+        }
 
 
-def byzantine_main(smoke: bool) -> None:
-    """Byzantine vote-flood soak (see module docstring, --byzantine)."""
-    from txflow_tpu.abci.kvstore import KVStoreApplication
-    from txflow_tpu.faults.byzantine import (
-        ByzantineVoteGen,
-        IdenticalVoteReplayer,
-        SigGarbageFlooder,
-        StaleVoteSpammer,
-    )
-    from txflow_tpu.health.byzantine import ByzantineConfig
-
-    def stall(msg: str) -> None:
-        print(f"SOAK STALL: {msg}", flush=True)
-        sys.exit(1)
+def byzantine_main(smoke: bool) -> dict:
+    """Byzantine vote-flood soak over real TCP (--byzantine)."""
+    import urllib.error
 
     duration = 10.0 if smoke else 45.0
-    commit_wait = 30.0 if smoke else 120.0
-    cfg = test_config()
-    cfg.consensus.skip_timeout_commit = True
+    commit_wait = float(
+        os.environ.get("SOAK_COMMIT_WAIT", "30" if smoke else "120")
+    )
+    n = 4
     # production-shaped posture, armed from t=0: the soak proves the live
     # breaker converges under full blast (the two-phase accounting proof
     # lives in tests/test_byzantine_gossip.py). strike_penalty stays 0 so
     # the scoreboard floor never tears down links mid-soak — link
     # evict/redial churn is the overload soak's subject, not this one's.
-    byz = ByzantineConfig(
-        min_samples=24,
-        max_bad_rate=0.5,
-        stale_height_slack=8,
-        quarantine_replays=True,
-        replay_min_samples=48,
-        replay_max_rate=0.7,
-        quarantine_secs=600.0,
-        strike_penalty=0.0,
-        quarantine_penalty=0.5,
-    )
-    net = LocalNet(
-        4,
-        use_device_verifier=False,
-        enable_consensus=True,
-        config=cfg,
-        byzantine_config=byz,
-    )
-    # validator 0 turns Byzantine: its consensus identity stays (quorum is
-    # now exactly the 3 honest keys), its fast-path signer is disarmed,
-    # and its switch carries the flood
-    net.nodes[0].txvote_reactor.priv_val = None
-    gen0 = ByzantineVoteGen(net.priv_vals[0], net.chain_id, seed=1)
-    rogue = ByzantineVoteGen(
-        MockPV(hashlib.sha256(b"soak-rogue").digest()), net.chain_id, seed=2
-    )
-    evil = Node(
-        node_id="evil-peer",
-        chain_id=net.chain_id,
-        val_set=net.val_set,
-        app=KVStoreApplication(),
-        priv_val=None,
-        node_config=NodeConfig(
-            config=cfg,
-            use_device_verifier=False,
-            enable_consensus=False,
-            sign_votes=False,
-            health=False,
-            sync=False,
-            byzantine_config=byz,
-        ),
-    )
-
-    honest_txs: list[bytes] = []
-    # forgeries target ghost txs (never in any mempool): their vote slots
-    # stay open, so garbage signatures are actually judged on the verify
-    # path instead of late-dropping against committed txs
-    ghost_txs = [b"soak-ghost%d" % i for i in range(8)]
-    targets = lambda: ghost_txs + honest_txs  # noqa: E731
-    height_fn = lambda: net.nodes[1].state_view().last_block_height  # noqa: E731
-    drivers = [
-        SigGarbageFlooder(
-            net.nodes[0].switch, gen0, targets, height_fn,
-            victim_address=net.priv_vals[1].get_address(),
-            batch=8, interval=0.03,
-        ),
-        StaleVoteSpammer(
-            net.nodes[0].switch, gen0, targets, height_fn,
-            lag=1000, batch=4, interval=0.05,
-        ),
-        SigGarbageFlooder(
-            evil.switch, rogue, targets, height_fn, batch=12, interval=0.02
-        ),
-    ]
-    honest = lambda: net.nodes[1:]  # noqa: E731
+    # quarantine_replays stays OFF on real TCP (the ledger's default, and
+    # the grid's posture): on a real mesh two honest peers routinely race
+    # to relay the same vote, and the loser's copy is a DROP_REPLAYED_SIG
+    # attributed to an HONEST relayer — arm the replay breaker here and
+    # the honest mesh quarantines itself (observed live: every honest
+    # pair mutually quarantined, commits stalled). The replay breaker's
+    # own semantics are proven on in-process nets in
+    # tests/test_byzantine_gossip.py, where delivery has no relay races.
+    spec = {
+        "chain_id": "txflow-byz",
+        "seed_prefix": "soak-byz",
+        "consensus": True,
+        "byzantine": {
+            "min_samples": 24,
+            "max_bad_rate": 0.5,
+            "stale_height_slack": 8,
+            "quarantine_replays": False,
+            "quarantine_secs": 600.0,
+            "strike_penalty": 0.0,
+            "quarantine_penalty": 0.5,
+        },
+        "engine": {"max_batch": 8, "min_batch": 1},
+        "regossip": 0.25,
+    }
+    # validator 0 turns Byzantine: its consensus identity stays (quorum
+    # is now exactly the 3 honest keys), its fast-path signer is
+    # disarmed on arm, and its switch carries the composed flood:
+    # garbage sigs (device verdicts), stale + unknown-signer votes
+    # (pre-check drops), and identical-vote replays (replay breaker)
+    adv_idx = 0
+    honest = [1, 2, 3]
     rng = random.Random(99)
-    sent: list[bytes] = []
+    ghosts = [b"soak-ghost-%d-%d" % (i, rng.randrange(1 << 30)) for i in range(8)]
+    schedule = {
+        "ghost_txs": [g.hex() for g in ghosts],
+        "drivers": [
+            {"kind": "sig-garbage", "seed": 1, "batch": 8, "interval": 0.03},
+            {"kind": "stale", "seed": 2, "batch": 4, "interval": 0.05,
+             "lag": 1000},
+            {"kind": "unknown-signer", "seed": 3, "batch": 12,
+             "interval": 0.02},
+            {"kind": "replayer", "signer_index": 2, "n_votes": 3,
+             "interval": 0.02},
+        ],
+    }
+    print(f"byzantine soak: starting {n}-process net ...", flush=True)
     t_start = time.monotonic()
-    try:
-        net.start()
-        evil.start()
-        for n in net.nodes:
-            connect_switches(evil.switch, n.switch)
-        deadline = time.monotonic() + 60
-        while height_fn() < 10:
-            if time.monotonic() > deadline:
-                stall("consensus never reached height 10")
-            time.sleep(0.1)
-        # evil replays a frame of validly-signed ghost votes forever: the
-        # pool entries never purge, so every redelivery is a countable
-        # sender-repeat
-        drivers.append(
-            IdenticalVoteReplayer(
-                evil.switch,
-                [
-                    ByzantineVoteGen(
-                        net.priv_vals[2], net.chain_id
-                    ).honest_vote(tx, height_fn())
-                    for tx in ghost_txs[:3]
-                ],
-                interval=0.01,
-            )
+    with H.live_net(n, spec) as net:
+        adv_id = net.infos[adv_idx]["node_id"]
+        H.wait_mesh(net, range(n), n - 1, deadline_s=20)
+        # stale votes clamp their height to 0: they are only judged
+        # stale once honest heights clear the slack, so let consensus
+        # reach height 10 before arming (the old LocalNet soak's gate)
+        H.wait_height(
+            net, honest, 10, 90.0, field="consensus_height", label="byzantine"
         )
-        for d in drivers:
-            d.start()
+        marks = H.adversary_activity_marks(net, honest, adv_id)
+        net.set_adversary(adv_idx, True, schedule=schedule)
+        # latch conviction while the net is quiet: once armed, the
+        # adversary's valid relays of honest votes would race its bad
+        # fraction away from the breaker line under load
+        H.wait_quarantined(net, honest, adv_id, 30.0, label="byzantine")
+        print("adversary quarantined on every honest node", flush=True)
 
         # continuous honest load while the flood runs at full blast
+        sent: list[str] = []
+        shed = 0
         t0 = time.monotonic()
-        phase = 0
+        k = 0
         while time.monotonic() - t0 < duration:
-            phase += 1
-            for _ in range(rng.randrange(2, 6)):
-                tx = b"byz-soak-%d-%d=v" % (phase, rng.randrange(1 << 30))
-                sent.append(tx)
-                try:
-                    net.broadcast_tx(tx, node_index=rng.randrange(1, 4))
-                except Exception:
-                    pass
-            time.sleep(0.05)
+            k += 1
+            tx = f"byz-soak-{k}-{rng.randrange(1 << 30)}=v"
+            try:
+                sent.append(H.broadcast(net, honest[k % 3], tx))
+            except urllib.error.HTTPError as e:
+                if e.code != 429:
+                    raise
+                shed += 1
+            time.sleep(0.12)
 
-        # zero admitted-tx loss under the flood
+        # zero admitted-tx loss under the flood, on every honest node
         tail = sent[-200:]
-        if not net.wait_all_committed(tail, timeout=commit_wait):
-            stall(
-                f"admitted txs failed to commit within {commit_wait:.0f}s "
-                f"under the Byzantine flood"
-            )
-        # every adversary struck AND quarantined on every honest node
-        q_deadline = time.monotonic() + 30
-        for nid in ("node0", "evil-peer"):
-            while not all(n.byzantine_ledger.quarantined(nid) for n in honest()):
-                if time.monotonic() > q_deadline:
-                    stall(f"{nid} never quarantined on every honest node")
-                time.sleep(0.2)
-            for n in honest():
-                if not n.byzantine_ledger.strikes_of(nid) > 0:
-                    stall(f"{nid} has no strikes on {n.node_id}")
-        # the front door is absorbing the still-running flood
+        H.assert_all_committed(
+            net, tail, honest, commit_wait,
+            what=f"honest txs under the Byzantine flood ({len(tail)} tail)",
+        )
+        # the adversary stayed quarantined AND the tile saw fresh
+        # evidence (strike or gated-drop deltas vs the pre-arm marks)
+        verdict = H.assert_adversary_quarantined(
+            net, honest, adv_id, marks, 30.0, label="byzantine"
+        )
+        # the front door is absorbing the still-running flood: gated
+        # (quarantined) drops must be GROWING on every honest node
         gate_deadline = time.monotonic() + 20
         while True:
-            gated = [
-                sum(
-                    p.get("drops", {}).get("quarantined", 0)
-                    for p in n.byzantine_ledger.snapshot()["peers"].values()
-                )
-                for n in honest()
-            ]
-            if all(g > 0 for g in gated):
+            gated = {
+                i: (H.byzantine_peer_state(net, i, adv_id).get("drops") or {})
+                .get("quarantined", 0) - marks[i][1]
+                for i in honest
+            }
+            if all(g > 0 for g in gated.values()):
                 break
             if time.monotonic() > gate_deadline:
-                stall(f"front-door gate absorbed nothing: {gated}")
+                raise H.Breach(
+                    "adversary", f"front-door gate absorbed nothing: {gated}"
+                )
             time.sleep(0.2)
 
         # post-quarantine waste bound: drain in-flight verdicts, then
         # commit a fresh batch under the (blocked) flood
-        def invalids():
-            return [int(n.metrics.invalid_votes.value()) for n in honest()]
+        def invalids() -> list[int]:
+            return [
+                int(net.metrics_value(i, "txflow_txflow_invalid_votes") or 0)
+                for i in honest
+            ]
 
         stable = invalids()
         stable_since = time.monotonic()
@@ -608,54 +530,62 @@ def byzantine_main(smoke: bool) -> None:
             time.sleep(0.1)
         base = [
             (
-                int(n.metrics.verified_votes.value()),
-                int(n.metrics.invalid_votes.value()),
+                int(net.metrics_value(i, "txflow_txflow_verified_votes") or 0),
+                int(net.metrics_value(i, "txflow_txflow_invalid_votes") or 0),
             )
-            for n in honest()
+            for i in honest
         ]
-        fresh = [b"byz-post-%d=v" % i for i in range(8)]
-        sent.extend(fresh)
-        for i, tx in enumerate(fresh):
-            net.broadcast_tx(tx, node_index=1 + i % 3)
-        if not net.wait_all_committed(fresh, timeout=commit_wait):
-            stall("post-quarantine batch failed to commit")
-        for n, (v0, i0) in zip(honest(), base):
-            dv = int(n.metrics.verified_votes.value()) - v0
-            di = int(n.metrics.invalid_votes.value()) - i0
+        fresh = [
+            H.broadcast(net, honest[i % 3], f"fee=1;byz-post-{i}=v")
+            for i in range(8)
+        ]
+        H.assert_all_committed(
+            net, fresh, honest, commit_wait, what="post-quarantine batch"
+        )
+        waste = {}
+        for i, (v0, i0) in zip(honest, base):
+            dv = int(net.metrics_value(i, "txflow_txflow_verified_votes") or 0) - v0
+            di = int(net.metrics_value(i, "txflow_txflow_invalid_votes") or 0) - i0
             if dv <= 0:
-                stall(f"{n.node_id}: no honest votes reached the device")
+                raise H.Breach(
+                    "adversary", f"node {i}: no honest votes reached the device"
+                )
             rate = di / (di + dv)
+            waste[i] = round(rate, 4)
             if rate >= 0.05:
-                stall(
-                    f"{n.node_id}: post-quarantine invalid rate {rate:.3f} "
-                    f"(invalid {di} / dispatched {di + dv})"
+                raise H.Breach(
+                    "adversary",
+                    f"node {i}: post-quarantine invalid rate {rate:.3f} "
+                    f"(invalid {di} / dispatched {di + dv})",
                 )
 
-        for d in drivers:
-            if not (d.frames > 0 and d.emitted > 0):
-                stall(f"adversary driver {type(d).__name__} never fired")
-        snaps = [n.byzantine_ledger.snapshot() for n in honest()]
-        drops = sum(s["pre_verify_drops"] for s in snaps)
-        strikes = sum(s["strikes"] for s in snaps)
-        quarantines = sum(s["quarantines"] for s in snaps)
-        emitted = sum(d.emitted for d in drivers)
+        ack = net.set_adversary(adv_idx, False)
+        emitted = int(ack.get("emitted") or 0)
+        if emitted <= 0:
+            raise H.Breach(
+                "adversary", "adversary fleet reports zero emitted frames"
+            )
         print(
             f"SOAK OK (byzantine): {duration:.0f}s flood "
             f"({time.monotonic() - t_start:.0f}s total), "
-            f"{emitted} hostile votes emitted, {len(sent)} honest txs "
-            f"zero loss, {strikes} strikes / {quarantines} quarantines / "
-            f"{drops} pre-verify drops across honest nodes, "
+            f"{emitted} hostile frames emitted, {len(sent)} honest txs "
+            f"zero loss ({shed} shed), strikes "
+            f"{verdict['strike_deltas']} / gated drops "
+            f"{verdict['gated_drop_deltas']} across honest nodes, "
             f"post-quarantine invalid rate < 5% on every node",
             flush=True,
         )
-    finally:
-        for d in drivers:
-            d.stop()
-        evil.stop()
-        net.stop()
+        return {
+            "emitted": emitted,
+            "honest_txs": len(sent),
+            "shed": shed,
+            "strike_deltas": verdict["strike_deltas"],
+            "gated_drop_deltas": verdict["gated_drop_deltas"],
+            "waste_rates": waste,
+        }
 
 
-def wan_matrix_main(smoke: bool) -> None:
+def wan_matrix_main(smoke: bool) -> dict:
     """WAN weather scenario matrix over real sockets (--wan-matrix).
 
     One long-lived 3-process net (real TCP, netem LinkShaper + adaptive
@@ -664,27 +594,17 @@ def wan_matrix_main(smoke: bool) -> None:
     probes measure commit latency against the profile's p50/p99 budgets
     (scaled by SOAK_WAN_BUDGET_SCALE, floored by SOAK_P50_BUDGET_MS),
     bulk txs ride along, and at quiescence the matrix asserts ZERO
-    admitted-tx loss (every hash committed on every node), per-node
-    commit-log PREFIX STABILITY (no node rewrites history under weather),
-    and cross-node committed-SET equality (there is no global total order
-    across fast-path nodes — each node's log is its own decision order).
-    After the walk: the shaper must have actually touched frames, the
-    adaptive transport must have real RTT samples, and the mesh must heal
-    back to full connectivity on calm weather with a BOUNDED number of
-    re-dial attempts. Writes a machine-readable matrix (SOAK_MATRIX_OUT).
-    SOAK_WAN_SCENARIOS picks the profiles; exits 1 with a SOAK STALL
-    banner on any breach. --smoke is tier-1-budget sized.
+    admitted-tx loss, per-node commit-log PREFIX STABILITY, and
+    cross-node committed-SET equality. After the walk: the shaper must
+    have actually touched frames, the adaptive transport must have real
+    RTT samples, and the mesh must heal back to full connectivity on
+    calm weather with a BOUNDED number of re-dial attempts. Writes a
+    machine-readable matrix (SOAK_MATRIX_OUT). SOAK_WAN_SCENARIOS picks
+    the profiles. --smoke is tier-1-budget sized.
     """
     import json
-    import statistics
-    import urllib.request
 
     from txflow_tpu.netem import get_profile
-    from txflow_tpu.node.procnet import ProcNet
-
-    def stall(msg: str) -> None:
-        print(f"SOAK STALL: {msg}", flush=True)
-        sys.exit(1)
 
     scenarios = [
         s.strip()
@@ -698,60 +618,36 @@ def wan_matrix_main(smoke: bool) -> None:
     floor_ms = float(os.environ.get("SOAK_P50_BUDGET_MS", "0"))
     # SOAK_COMMIT_WAIT: relief valve for heavily-shared boxes — the
     # post-scenario backlog drains at whatever rate the contended cores
-    # allow, and calling slow drain "loss" would turn a latency statement
-    # into a false negative
+    # allow, and calling slow drain "loss" would turn a latency
+    # statement into a false negative
     commit_wait = float(os.environ.get("SOAK_COMMIT_WAIT", "25" if smoke else "90"))
     n_probes = 4 if smoke else 12
     n_bulk = 8 if smoke else 40
     n = 3
 
-    net = ProcNet(
-        n,
-        spec={
-            "chain_id": "txflow-wan",
-            "seed_prefix": "soak-wan",
-            # the whole point: every link shaped, adaptive transport on
-            "netem": {"profile": "lan", "seed": 11},
-            "net": True,
-            # scalar (host) verify: small batches keep head-of-line
-            # blocking out of the probe latencies (see overload_main)
-            "engine": {"max_batch": 8, "min_batch": 1},
-            "regossip": 0.25,
-        },
-    )
+    spec = {
+        "chain_id": "txflow-wan",
+        "seed_prefix": "soak-wan",
+        # the whole point: every link shaped, adaptive transport on
+        "netem": {"profile": "lan", "seed": 11},
+        "net": True,
+        # scalar (host) verify: small batches keep head-of-line
+        # blocking out of the probe latencies (see overload_main)
+        "engine": {"max_batch": 8, "min_batch": 1},
+        "regossip": 0.25,
+    }
     print(
         f"wan matrix: starting {n}-process net "
         f"(scenarios: {', '.join(scenarios)})",
         flush=True,
     )
     t_start = time.monotonic()
-    net.start()
     matrix: dict = {"smoke": smoke, "budget_scale": scale, "scenarios": []}
-    try:
+    with H.live_net(n, spec) as net:
         fails0 = sum(
             net.rpc_json(i, "/health")["result"]["peers"]["reconnect_failures"]
             for i in range(n)
         )
-
-        def commit_latency(i: int, tx: str, timeout: float) -> tuple[float | None, str]:
-            host, port = net.rpc_addr(i)
-            t0 = time.monotonic()
-            with urllib.request.urlopen(
-                f'http://{host}:{port}/broadcast_tx_commit?tx="{tx}"'
-                f"&timeout={timeout}",
-                timeout=timeout + 5,
-            ) as r:
-                res = json.loads(r.read().decode())["result"]
-            lat = time.monotonic() - t0 if res.get("committed") else None
-            return lat, res["hash"]
-
-        def broadcast(i: int, tx: str) -> str:
-            host, port = net.rpc_addr(i)
-            with urllib.request.urlopen(
-                f'http://{host}:{port}/broadcast_tx?tx="{tx}"', timeout=10
-            ) as r:
-                return json.loads(r.read().decode())["result"]["hash"]
-
         for name in scenarios:
             prof = get_profile(name)  # unknown name -> KeyError w/ options
             scaled = prof.scaled_budgets(scale)
@@ -766,98 +662,45 @@ def wan_matrix_main(smoke: bool) -> None:
             )
             net.set_netem(name)
             time.sleep(0.5)  # frames in flight drain onto the new weather
-            # pre-scenario commit-log heads: cheap digest-to-date probes
-            # the post-scenario prefix check compares against
-            pre = [
-                net.rpc_json(i, "/commit_log?count=0")["result"] for i in range(n)
-            ]
+            # pre-scenario commit-log heads for the prefix-stability check
+            pre = H.commit_log_heads(net, range(n))
 
             lats: list[float] = []
             hashes: list[str] = []
             slow: list[str] = []
             probe_timeout = max(p99_budget / 1e3, 5.0)
             for p in range(n_probes):
-                lat, h = commit_latency(
-                    p % n, f"fee=1;{name}-probe-{p}=v", probe_timeout
+                lat, h = H.commit_latency(
+                    net, p % n, f"fee=1;{name}-probe-{p}=v", probe_timeout
                 )
                 hashes.append(h)
                 if lat is None:
-                    # count at full timeout so a slow probe still drags the
-                    # percentiles; loss is judged below once it had time to
-                    # land
+                    # count at full timeout so a slow probe still drags
+                    # the percentiles; loss is judged below once it had
+                    # time to land
                     slow.append(h)
                     lats.append(probe_timeout)
                 else:
                     lats.append(lat)
             for b in range(n_bulk):
-                hashes.append(broadcast(b % n, f"{name}-bulk-{b}=v"))
+                hashes.append(H.broadcast(net, b % n, f"{name}-bulk-{b}=v"))
 
-            # zero admitted-tx loss: every accepted hash commits on EVERY
-            # node (weather may drop frames; the reliable lane + anti-
-            # entropy re-walk must still deliver)
-            deadline = time.monotonic() + commit_wait
-            remaining = {i: set(hashes) for i in range(n)}
-            while any(remaining.values()) and time.monotonic() < deadline:
-                for i in range(n):
-                    remaining[i] = {
-                        h
-                        for h in remaining[i]
-                        if not net.rpc_json(i, f"/tx?hash={h}")["result"][
-                            "committed"
-                        ]
-                    }
-                if any(remaining.values()):
-                    time.sleep(0.4)
-            missing = {i: len(r) for i, r in remaining.items() if r}
-            if missing:
-                stall(f"[{name}] admitted txs never committed: {missing}")
+            # zero admitted-tx loss: every accepted hash commits on
+            # EVERY node (weather may drop frames; the reliable lane +
+            # anti-entropy re-walk must still deliver)
+            H.assert_all_committed(
+                net, hashes, range(n), commit_wait,
+                what=f"[{name}] admitted txs",
+            )
+            # weather may delay commits but never rewrite history, and
+            # fast-path nodes must agree on the committed SET
+            H.assert_prefix_stable(net, pre, label=name)
+            logs = H.assert_committed_sets_equal(
+                net, range(n), commit_wait, label=name
+            )
 
-            # per-node prefix stability: the log a node had BEFORE this
-            # scenario must be an exact prefix of its log now — weather
-            # may delay commits but may never rewrite committed history
-            for i in range(n):
-                res = net.rpc_json(
-                    i, f"/commit_log?start=0&count={pre[i]['total']}"
-                )["result"]
-                digest = hashlib.sha256()
-                for h in res["hashes"]:
-                    digest.update(h.encode())
-                if digest.hexdigest() != pre[i]["digest"]:
-                    stall(f"[{name}] node {i} rewrote its committed prefix")
-
-            # cross-node committed-SET equality: no global total order
-            # exists across fast-path nodes, so the fork check compares
-            # sets, not sequences (order is asserted per-node above)
-            set_deadline = time.monotonic() + commit_wait
-            logs = []
-            sets_equal = False
-            while time.monotonic() < set_deadline:
-                logs = [
-                    net.rpc_json(i, "/commit_log")["result"] for i in range(n)
-                ]
-                sets = [frozenset(lg["hashes"]) for lg in logs]
-                if all(s == sets[0] for s in sets):
-                    sets_equal = True
-                    break
-                time.sleep(0.4)
-            if not sets_equal:
-                stall(
-                    f"[{name}] committed sets diverged: "
-                    f"totals {[lg['total'] for lg in logs]}"
-                )
-
-            p50 = statistics.median(lats) * 1e3
-            p99 = max(lats) * 1e3  # max: sample counts are far below 100
-            if p50 > p50_budget:
-                stall(
-                    f"[{name}] commit p50 {p50:.0f}ms breached the "
-                    f"{p50_budget:.0f}ms budget"
-                )
-            if p99 > p99_budget:
-                stall(
-                    f"[{name}] commit p99 {p99:.0f}ms breached the "
-                    f"{p99_budget:.0f}ms budget"
-                )
+            p50, p99 = H.percentiles(lats)
+            H.assert_slo(p50, p99, p50_budget, p99_budget, label=name)
             network = net.rpc_json(0, "/health")["result"].get("network") or {}
             matrix["scenarios"].append(
                 {
@@ -888,12 +731,16 @@ def wan_matrix_main(smoke: bool) -> None:
             for i in range(n)
         )
         if frames <= 0:
-            stall("shaper saw zero frames: weather was never applied")
+            raise H.Breach(
+                "liveness", "shaper saw zero frames: weather was never applied"
+            )
         pongs = sum(
             net.metrics_value(i, "txflow_net_pongs") or 0.0 for i in range(n)
         )
         if pongs <= 0:
-            stall("adaptive transport measured zero RTT samples")
+            raise H.Breach(
+                "liveness", "adaptive transport measured zero RTT samples"
+            )
         corrupted = sum(
             net.metrics_value(i, "txflow_net_shaped_corrupted") or 0.0
             for i in range(n)
@@ -917,17 +764,7 @@ def wan_matrix_main(smoke: bool) -> None:
         # connectivity with a BOUNDED number of re-dial attempts (a dial
         # storm under flapping weather is its own failure mode) --
         net.set_netem("lan")
-        heal_deadline = time.monotonic() + 30.0
-        while True:
-            n_peers = [
-                net.rpc_json(i, "/net_info")["result"]["n_peers"]
-                for i in range(n)
-            ]
-            if all(p >= n - 1 for p in n_peers):
-                break
-            if time.monotonic() > heal_deadline:
-                stall(f"mesh never healed on calm weather: peers {n_peers}")
-            time.sleep(0.4)
+        H.wait_mesh(net, range(n), n - 1, 30.0, label="calm-weather heal")
         fails = (
             sum(
                 net.rpc_json(i, "/health")["result"]["peers"][
@@ -939,9 +776,10 @@ def wan_matrix_main(smoke: bool) -> None:
         )
         dial_cap = 40 * max(len(scenarios), 1)
         if fails > dial_cap:
-            stall(
+            raise H.Breach(
+                "liveness",
                 f"unbounded dial churn: {fails} failed re-dial attempts "
-                f"(cap {dial_cap})"
+                f"(cap {dial_cap})",
             )
 
         matrix["net_metrics"] = {
@@ -965,34 +803,33 @@ def wan_matrix_main(smoke: bool) -> None:
             f"({fails} bounded re-dial failures)",
             flush=True,
         )
-    finally:
-        net.stop()
+        return {
+            "scenarios": [s["scenario"] for s in matrix["scenarios"]],
+            "p50_ms": {
+                s["scenario"]: s["p50_ms"] for s in matrix["scenarios"]
+            },
+            "net_metrics": matrix["net_metrics"],
+            "out": out,
+        }
 
 
-def main() -> None:
-    args = [a for a in sys.argv[1:] if not a.startswith("--")]
-    smoke = "--smoke" in sys.argv
-    if "--overload" in sys.argv:
-        overload_main(smoke)
-        return
-    if "--wan-matrix" in sys.argv:
-        wan_matrix_main(smoke)
-        return
-    if "--byzantine" in sys.argv:
-        byzantine_main(smoke)
-        return
+def churn_main(duration: float, smoke: bool) -> dict:
+    """In-process churn soak (default mode; see module docstring)."""
     import jax
 
+    from txflow_tpu.node import LocalNet
+    from txflow_tpu.node.node import Node, NodeConfig
+    from txflow_tpu.p2p import connect_switches
+    from txflow_tpu.store.db import FileDB
+    from txflow_tpu.types import TxVote
+    from txflow_tpu.types.priv_validator import MockPV
+    from txflow_tpu.utils.config import test_config
+
     jax.config.update("jax_platforms", "cpu")
-    duration = float(args[0]) if args else (10.0 if smoke else 120.0)
     # quiescence budgets: smoke runs must fail FAST on a stall, not sit
     # in a 2-minute wait — a stalled 10s run is the signal, after all
     commit_wait = 30.0 if smoke else 120.0
     height_wait = 15.0 if smoke else 60.0
-
-    def stall(msg: str) -> None:
-        print(f"SOAK STALL: {msg}", flush=True)
-        sys.exit(1)
 
     rng = random.Random(1234)
     cfg = test_config()
@@ -1125,9 +962,11 @@ def main() -> None:
         if cut is not None:
             connect_switches(net.nodes[cut[0]].switch, net.nodes[cut[1]].switch)
         tail = sent[-200:]
-        ok = net.wait_all_committed(tail, timeout=commit_wait)
-        if not ok:
-            stall(f"tail txs failed to commit within {commit_wait:.0f}s of heal")
+        if not net.wait_all_committed(tail, timeout=commit_wait):
+            raise H.Breach(
+                "loss",
+                f"tail txs failed to commit within {commit_wait:.0f}s of heal",
+            )
         heights = [n.consensus.state.last_block_height for n in net.nodes]
         deadline = time.monotonic() + height_wait
         while time.monotonic() < deadline:
@@ -1136,37 +975,61 @@ def main() -> None:
                 break
             time.sleep(0.2)
         else:
-            stall(f"block heights diverged past deadline: {heights}")
+            raise H.Breach(
+                "liveness", f"block heights diverged past deadline: {heights}"
+            )
         h = min(heights)
         if h > 0:
             b0 = net.nodes[0].block_store.load_block(h)
-            for n in net.nodes[1:]:
-                b = n.block_store.load_block(h)
-                assert b is not None and b.hash() == b0.hash(), (
-                    f"FORK at height {h}"
-                )
+            for nd in net.nodes[1:]:
+                b = nd.block_store.load_block(h)
+                if b is None or b.hash() != b0.hash():
+                    raise H.Breach("divergence", f"FORK at height {h}")
         # Cross-node app equality: the kvstore's chained digest is ORDER-
         # dependent, and fast-path apply order is legitimately per-node
         # (the reference's realtime path has the same property — blocks,
-        # not the live app hash, carry the canonical order; that is why
-        # block headers here commit to a pure function of block history).
-        # The invariants that must hold are identical CONTENT and count.
+        # not the live app hash, carry the canonical order). The
+        # invariants that must hold are identical CONTENT and count.
         s0 = net.nodes[0].app.state
-        for n in net.nodes[1:]:
-            assert n.app.state == s0, "kv state diverged"
-        counts = {n.app.tx_count for n in net.nodes}
-        assert len(counts) == 1, f"apply counts diverged: {counts}"
-        pool_sizes = [n.tx_vote_pool.size() for n in net.nodes]
+        for nd in net.nodes[1:]:
+            if nd.app.state != s0:
+                raise H.Breach("divergence", "kv state diverged")
+        counts = {nd.app.tx_count for nd in net.nodes}
+        if len(counts) != 1:
+            raise H.Breach("divergence", f"apply counts diverged: {counts}")
+        pool_sizes = [nd.tx_vote_pool.size() for nd in net.nodes]
         committed = sum(
-            int(n.txflow.metrics.committed_txs.value()) for n in net.nodes
+            int(nd.txflow.metrics.committed_txs.value()) for nd in net.nodes
         )
         print(
-            f"SOAK OK: {duration:.0f}s, {phase} phases, {len(sent)} txs sent, "
-            f"{committed} commits across nodes, heights {heights}, "
-            f"pool sizes {pool_sizes}, no forks, apps agree"
+            f"SOAK OK (churn): {duration:.0f}s, {phase} phases, "
+            f"{len(sent)} txs sent, {committed} commits across nodes, "
+            f"heights {heights}, pool sizes {pool_sizes}, no forks, "
+            f"apps agree",
+            flush=True,
         )
+        return {
+            "phases": phase,
+            "txs_sent": len(sent),
+            "commits": committed,
+            "heights": heights,
+            "pool_sizes": pool_sizes,
+        }
     finally:
         net.stop()
+
+
+def main() -> None:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    smoke = "--smoke" in sys.argv
+    if "--overload" in sys.argv:
+        H.run_mode("overload", lambda: overload_main(smoke))
+    if "--wan-matrix" in sys.argv:
+        H.run_mode("wan-matrix", lambda: wan_matrix_main(smoke))
+    if "--byzantine" in sys.argv:
+        H.run_mode("byzantine", lambda: byzantine_main(smoke))
+    duration = float(args[0]) if args else (10.0 if smoke else 120.0)
+    H.run_mode("churn", lambda: churn_main(duration, smoke))
 
 
 if __name__ == "__main__":

@@ -17,12 +17,13 @@ Every rejection is surfaced via ``txflow_admission_*`` metrics — never a
 silent drop.
 """
 
-from .config import AdmissionConfig
+from .config import AdmissionConfig, soak_spec_overrides
 from .classifier import FeeLaneClassifier, parse_fee
 from .controller import AdmissionController, ErrDuplicateTx, ErrOverloaded
 
 __all__ = [
     "AdmissionConfig",
+    "soak_spec_overrides",
     "AdmissionController",
     "ErrDuplicateTx",
     "ErrOverloaded",

@@ -98,3 +98,49 @@ class AdmissionConfig:
     # bounded number of tracked sender buckets (stalest-evicted like the
     # peer buckets — hostile sender churn can't grow memory)
     priority_sender_max: int = 256
+
+
+def soak_spec_overrides() -> dict:
+    """The shared admission posture for multi-process soak/grid nets
+    (tools/soak.py --overload and the scenario-grid runner), as plain
+    JSON-able kwargs for the procnode ``admission`` spec field.
+
+    The numbers encode one capacity statement: these boxes run 4 nodes
+    on shared cores with the scalar (host) verifier at ~5 ms/signature,
+    so system-wide commit capacity is a few tx/s. Admitting bulk faster
+    than committing grows the pending backlog (sign walks + regossip
+    re-walks scale with it) and probe latency degrades minute over
+    minute — 1 tx/s per RPC node holds the backlog in equilibrium while
+    the flood sheds with 429 + Retry-After. Tight retry_after and
+    pressure_interval keep the shed loop responsive at soak timescales.
+
+    ``bulk_rate_floor`` and ``bulk_rate_headroom`` matter as much as
+    ``bulk_rate``: the node wires a commit_rate_source, which flips the
+    controller into ADAPTIVE bulk rating — and the adaptive path reads
+    ``max(bulk_rate_floor, ewma * headroom)``, never the static
+    ``bulk_rate``. The default floor (50 tx/s, sized for device-verify
+    builds) silently un-caps a scalar soak box, and the default headroom
+    (1.25) admits ABOVE the measured commit rate — correct for a box
+    with latency slack, but on a saturated soak box it guarantees a
+    growing bulk queue and a priority p50 that degrades minute over
+    minute (observed live: p50 3.1s against a 750ms budget).
+
+    Headroom must also divide by the FAN-IN: every node's EWMA measures
+    the SYSTEM commit throughput (each node commits every tx), so K
+    front doors taking load each admit ``headroom x capacity`` and the
+    aggregate is ``K x headroom x capacity``. The soak and grid rigs
+    spread their floods over ~2 RPC targets, so per-node headroom must
+    sit below 1/2 for the aggregate to stay sub-capacity — 0.35 lands
+    the fleet at ~0.7x of what the box has proven it can commit, which
+    turns the feedback loop into a drain: the backlog shrinks whenever
+    it exists (observed live: headroom 0.7 still left p50 at 1.4s;
+    0.35 brought it back under budget).
+    """
+    return {
+        "retry_after": 0.25,
+        "pressure_interval": 0.02,
+        "bulk_rate": 1.0,
+        "bulk_burst": 2.0,
+        "bulk_rate_floor": 1.0,
+        "bulk_rate_headroom": 0.35,
+    }

@@ -455,3 +455,80 @@ class SelectiveWithholder:
                     self.signed += 1
                 except Exception:
                     continue
+
+
+def drivers_from_schedule(
+    switch, priv_val, chain_id: str, driver_specs, targets, height_fn,
+    signer_lookup=None,
+):
+    """Assemble a flood-driver fleet from a scenario-grid adversary
+    schedule (scenario/spec.py draws the knobs from the adversary PRNG
+    domain; this owns turning each drawn dict into a live driver, so the
+    schedule format and the drivers evolve together in faults/).
+
+    ``driver_specs``: list of dicts, each with a ``kind`` plus that
+    kind's drawn knobs. ``targets``/``height_fn`` are the usual flood
+    callables. ``signer_lookup(index) -> priv validator`` is required by
+    ``replayer`` specs: replayed votes are validly signed by ANOTHER
+    validator's key — the replay breaker judges the SENDER's repeats,
+    not the signature.
+    """
+    drivers = []
+    for d in driver_specs:
+        kind = d.get("kind")
+        if kind == "sig-garbage":
+            gen = ByzantineVoteGen(priv_val, chain_id, seed=int(d.get("seed", 0)))
+            drivers.append(
+                SigGarbageFlooder(
+                    switch, gen, targets, height_fn,
+                    batch=int(d.get("batch", 8)),
+                    interval=float(d.get("interval", 0.03)),
+                )
+            )
+        elif kind == "stale":
+            gen = ByzantineVoteGen(priv_val, chain_id, seed=int(d.get("seed", 0)))
+            drivers.append(
+                StaleVoteSpammer(
+                    switch, gen, targets, height_fn,
+                    lag=int(d.get("lag", 1000)),
+                    batch=int(d.get("batch", 4)),
+                    interval=float(d.get("interval", 0.05)),
+                )
+            )
+        elif kind == "unknown-signer":
+            # the rogue non-validator flood: garbage-signed votes whose
+            # signer is not in the validator set at all, so honest nodes
+            # judge them at the pre-check (unknown validator) instead of
+            # the device verify path
+            from ..types.priv_validator import MockPV
+
+            rogue = MockPV(
+                hashlib.sha256(
+                    b"rogue-signer-%d" % int(d.get("seed", 0))
+                ).digest()
+            )
+            gen = ByzantineVoteGen(rogue, chain_id, seed=int(d.get("seed", 0)))
+            drivers.append(
+                SigGarbageFlooder(
+                    switch, gen, targets, height_fn,
+                    batch=int(d.get("batch", 12)),
+                    interval=float(d.get("interval", 0.02)),
+                )
+            )
+        elif kind == "replayer":
+            if signer_lookup is None:
+                raise ValueError("replayer spec needs a signer_lookup")
+            gen = ByzantineVoteGen(
+                signer_lookup(int(d.get("signer_index", 1))), chain_id
+            )
+            txs = list(targets())[: int(d.get("n_votes", 3))]
+            drivers.append(
+                IdenticalVoteReplayer(
+                    switch,
+                    [gen.honest_vote(tx, 0) for tx in txs],
+                    interval=float(d.get("interval", 0.01)),
+                )
+            )
+        else:
+            raise ValueError(f"unknown adversary driver kind {kind!r}")
+    return drivers

@@ -16,13 +16,14 @@ import threading
 
 from ..analysis.lockgraph import make_lock
 
-from ..utils.metrics import HealthMetrics, NetMetrics, Registry
+from ..utils.metrics import HealthMetrics, NetMetrics, Registry, ScenarioMetrics
 
 
 class DegradedModeRegistry:
     def __init__(self, metrics_registry: Registry):
         self.metrics = HealthMetrics(metrics_registry)
         self.net_metrics = NetMetrics(metrics_registry)
+        self.scenario_metrics = ScenarioMetrics(metrics_registry)
         self._mtx = make_lock("health.DegradedModeRegistry._mtx")
         # event totals (watchdog + peer scorer hooks)
         self.watchdog_firings = 0
@@ -41,6 +42,7 @@ class DegradedModeRegistry:
         self._storage: dict = {}
         self._network: dict = {}
         self._byzantine: dict = {}
+        self._scenario: dict = {}
         self._watchdog_state: dict = {"inflight": 0, "oldest_stall_age": 0.0}
         self._healthy = True
 
@@ -76,6 +78,17 @@ class DegradedModeRegistry:
         with self._mtx:
             self.reconnect_failures += 1
         self.metrics.reconnect_failures.add(1)
+
+    def set_scenario(self, info: dict | None) -> None:
+        """Publish (or clear, with ``None``/``{}``) the scenario-grid
+        tile currently driving this node (scenario/ runner, via the
+        procnode ``{"cmd": "scenario"}`` control). The dict lands
+        verbatim as the ``/health`` "scenario" section; the numeric
+        shape is mirrored into the ``txflow_scenario_*`` gauges."""
+        info = dict(info or {})
+        with self._mtx:
+            self._scenario = info
+        self.scenario_metrics.refresh_from(info)
 
     # -- tick refresh --
 
@@ -245,4 +258,5 @@ class DegradedModeRegistry:
                 "storage": dict(self._storage),
                 "network": dict(self._network),
                 "byzantine": dict(self._byzantine),
+                "scenario": dict(self._scenario),
             }

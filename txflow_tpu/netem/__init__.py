@@ -11,13 +11,14 @@ estimators, bounded send queues, slow-peer quarantine); the proof lives in
 ``tools/soak.py --wan-matrix``.
 """
 
-from .profiles import PROFILES, NetProfile, get_profile
+from .profiles import PROFILES, NetProfile, get_profile, profile_names
 from .shaper import LinkShaper, ShapedConnection
 
 __all__ = [
     "PROFILES",
     "NetProfile",
     "get_profile",
+    "profile_names",
     "LinkShaper",
     "ShapedConnection",
 ]

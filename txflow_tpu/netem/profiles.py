@@ -125,3 +125,10 @@ def get_profile(name_or_profile) -> NetProfile:
             f"unknown net profile {name_or_profile!r}; "
             f"known: {sorted(PROFILES)}"
         ) from None
+
+
+def profile_names() -> tuple[str, ...]:
+    """Profile names in canonical (declaration) order — "lan" first. The
+    scenario grid's weather axis levels ARE this tuple, so its baseline
+    level and tile ordering track the profile table automatically."""
+    return tuple(PROFILES)

@@ -145,6 +145,14 @@ class ProcNet:
 
     # -- live weather control (netem/) --
 
+    def _command(self, i: int, cmd: dict, ok: str, timeout: float = 10.0) -> dict:
+        """Send one control command to child ``i`` and wait for its ack
+        line (``{"ok": <ok>, ...}``); returns the ack dict."""
+        child = self.children[i]
+        child.stdin.write(json.dumps(cmd) + "\n")
+        child.stdin.flush()
+        return self._wait_ack(i, ok, timeout)
+
     def set_netem(self, profile: str, links: dict | None = None, timeout: float = 10.0) -> None:
         """Swap every child's link weather live (children must have been
         started with a ``netem`` spec). Writes one control line per child
@@ -154,24 +162,54 @@ class ProcNet:
         for child in self.children:
             child.stdin.write(cmd + "\n")
             child.stdin.flush()
-        for i, child in enumerate(self.children):
-            deadline = time.monotonic() + timeout
-            while True:
-                line = child.stdout.readline()
-                if not line:
-                    raise RuntimeError(
-                        f"procnode {i} died during netem swap:\n{self._stderr_tail(i)}"
-                    )
-                try:
-                    ack = json.loads(line)
-                except ValueError:
-                    continue  # stray print from the child: skip
-                if ack.get("ok") == "netem":
-                    break
-                if "err" in ack:
-                    raise RuntimeError(f"procnode {i} netem swap: {ack['err']}")
-                if time.monotonic() > deadline:
-                    raise RuntimeError(f"procnode {i} netem ack timed out")
+        for i in range(len(self.children)):
+            self._wait_ack(i, "netem", timeout)
+
+    def _wait_ack(self, i: int, ok: str, timeout: float) -> dict:
+        deadline = time.monotonic() + timeout
+        child = self.children[i]
+        while True:
+            line = child.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"procnode {i} died during {ok} command:\n{self._stderr_tail(i)}"
+                )
+            try:
+                ack = json.loads(line)
+            except ValueError:
+                continue  # stray print from the child: skip
+            if ack.get("ok") == ok:
+                return ack
+            if "err" in ack:
+                raise RuntimeError(f"procnode {i} {ok} command: {ack['err']}")
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"procnode {i} {ok} ack timed out")
+
+    def set_adversary(
+        self,
+        i: int,
+        active: bool,
+        schedule: dict | None = None,
+        timeout: float = 10.0,
+    ) -> dict:
+        """Arm/disarm child ``i``'s adversary flood (spec field
+        ``adversary``, or ``schedule`` to swap in a fresh one while
+        disarmed); returns the ack, which carries the drivers' cumulative
+        ``emitted`` count (on disarm: the stopped fleet's final total)."""
+        cmd: dict = {"cmd": "adversary", "active": bool(active)}
+        if schedule is not None:
+            cmd["schedule"] = schedule
+        return self._command(i, cmd, "adversary", timeout)
+
+    def set_scenario(self, info: dict | None, timeout: float = 10.0) -> None:
+        """Publish (``info`` dict) or clear (``None``) the scenario tile
+        on EVERY child's /health + txflow_scenario_* surfaces."""
+        cmd = json.dumps({"cmd": "scenario", "info": info})
+        for child in self.children:
+            child.stdin.write(cmd + "\n")
+            child.stdin.flush()
+        for i in range(len(self.children)):
+            self._wait_ack(i, "scenario", timeout)
 
     def stop(self, timeout: float = 15.0) -> None:
         for child in self.children:

@@ -12,6 +12,11 @@ Spec line fields (all optional except index/n/seed_prefix):
 
     {"index": 0, "n": 3, "chain_id": "txflow-proc",
      "seed_prefix": "soak1",
+     "powers": [40, 10, 10],               # per-validator stake (default 10 each)
+     "consensus": true,                    # full block path (default: fast path only)
+     "byzantine": {"min_samples": 24},     # ByzantineConfig kwargs (vote-gossip breaker)
+     "adversary": {"ghost_txs": ["aa.."],  # scenario-grid flood schedule, armed
+                   "drivers": [{...}]},    #   later via the adversary command
      "mempool": {"size": 200},             # MempoolConfig field overrides
      "engine": {"max_batch": 64},          # EngineConfig field overrides
      "trace": {"sample_rate": 16},         # TraceConfig field overrides
@@ -29,9 +34,17 @@ Spec line fields (all optional except index/n/seed_prefix):
 dialed/accepted link is shaped); ``net`` enables the adaptive transport
 (defaults ON whenever netem is set). After startup the park loop doubles
 as a control channel: each stdin line that parses as JSON is a live
-command — ``{"cmd": "netem", "profile": "congested"}`` swaps the weather
-and acks ``{"ok": "netem", "profile": ...}`` on stdout (ProcNet.set_netem
-drives this to walk one long-lived net through a scenario matrix).
+command, acked with one JSON line on stdout —
+
+- ``{"cmd": "netem", "profile": "congested"}`` swaps the weather and
+  acks ``{"ok": "netem", "profile": ...}`` (ProcNet.set_netem drives
+  this to walk one long-lived net through a scenario matrix);
+- ``{"cmd": "adversary", "active": true|false}`` arms/disarms the
+  spec's ``adversary`` flood schedule on THIS child (disarms/rearms its
+  honest fast-path signer), acking ``{"ok": "adversary", ...}``;
+- ``{"cmd": "scenario", "info": {...}}`` publishes the scenario tile
+  currently driving this node into /health's "scenario" section and the
+  ``txflow_scenario_*`` gauges (``info: null`` clears it).
 
 ``blackhole`` makes THIS child's chaos router partition itself away for
 the window: its outbound gossip black-holes, so its PEERS observe
@@ -70,11 +83,28 @@ def main() -> None:
         MockPV(hashlib.sha256(f"{prefix}-val{i}".encode()).digest())
         for i in range(n)
     ]
-    vs = ValidatorSet([Validator.from_pub_key(pv.get_pub_key(), 10) for pv in pvs])
-    by_addr = {pv.get_address(): pv for pv in pvs}
-    me = by_addr[vs.get_by_index(index).address]
+    # per-validator voting powers (scenario grid's stake axis: whale /
+    # longtail / churning distributions); default stays uniform 10.
+    # Child i IS pvs[i] with powers[i] — the same spec list on every
+    # child, so the parent's index arithmetic (who is the whale, who is
+    # the adversary) matches the children's without an address sort in
+    # between (ValidatorSet orders by address internally regardless).
+    powers = spec.get("powers") or [10] * n
+    vs = ValidatorSet(
+        [
+            Validator.from_pub_key(pv.get_pub_key(), int(p))
+            for pv, p in zip(pvs, powers)
+        ]
+    )
+    me = pvs[index]
 
     config = test_config()
+    # "consensus": true runs the full block path (the scenario grid's
+    # churning-stake tiles commit val: txs through EndBlock -> H+2
+    # restage). skip_timeout_commit keeps block cadence test-shaped.
+    consensus_on = bool(spec.get("consensus"))
+    if consensus_on:
+        config.consensus.skip_timeout_commit = True
     for k, v in (spec.get("mempool") or {}).items():
         setattr(config.mempool, k, v)
     for k, v in (spec.get("engine") or {}).items():
@@ -110,6 +140,11 @@ def main() -> None:
         from ..health.config import HealthConfig
 
         health_config = HealthConfig(**spec["health"])
+    byzantine_config = None
+    if spec.get("byzantine"):
+        from ..health.byzantine import ByzantineConfig
+
+        byzantine_config = ByzantineConfig(**spec["byzantine"])
     sync_on = spec.get("sync", True)
     sync_config = None
     if isinstance(sync_on, dict):
@@ -145,8 +180,9 @@ def main() -> None:
         node_config=NodeConfig(
             config=config,
             use_device_verifier=False,
-            enable_consensus=False,
+            enable_consensus=consensus_on,
             rpc_port=0,
+            byzantine_config=byzantine_config,
             node_key_seed=hashlib.sha256(f"{prefix}-key-{index}".encode()).digest(),
             regossip_interval=spec.get("regossip", 0.25),
             admission_config=admission_config,
@@ -196,8 +232,61 @@ def main() -> None:
 
         threading.Thread(target=_blackhole, name="blackhole", daemon=True).start()
 
+    # scenario-grid adversary (faults/byzantine.py): the spec carries the
+    # drawn driver schedule; arming is a live command so one long-lived
+    # net can walk adversary and non-adversary tiles. Arming disarms THIS
+    # child's honest fast-path signer (its consensus identity stays — the
+    # honest remainder must clear quorum without it) and starts the
+    # flood; disarming stops the flood and rearms the signer.
+    adv_spec = spec.get("adversary") or {}
+    adv_drivers: list = []
+
+    def _adversary(active: bool, schedule: dict | None = None) -> dict:
+        nonlocal adv_spec, adv_drivers
+        if schedule:
+            # the command may swap in a fresh schedule (the grid runner
+            # walks tiles with different adversary mixes over one net)
+            if adv_drivers:
+                raise ValueError("disarm before swapping the schedule")
+            adv_spec = schedule
+        if active and not adv_spec:
+            raise ValueError("adversary not configured")
+        emitted = sum(d.emitted for d in adv_drivers)
+        if active and not adv_drivers:
+            from ..faults.byzantine import drivers_from_schedule
+
+            # forgeries target ghost txs (never in any mempool): their
+            # vote slots stay open, so garbage signatures are judged on
+            # the verify path instead of late-dropping as committed
+            ghosts = [bytes.fromhex(h) for h in adv_spec.get("ghost_txs", [])]
+            node.txvote_reactor.priv_val = None
+            adv_drivers = drivers_from_schedule(
+                node.switch,
+                me,
+                chain_id,
+                adv_spec.get("drivers", []),
+                targets=lambda: ghosts,
+                height_fn=lambda: node.committed_height_view,
+                signer_lookup=lambda i: pvs[i % n],
+            )
+            for d in adv_drivers:
+                d.start()
+        elif not active and adv_drivers:
+            for d in adv_drivers:
+                d.stop()
+            adv_drivers = []
+            node.txvote_reactor.priv_val = me
+        return {
+            "ok": "adversary",
+            "active": bool(adv_drivers),
+            # cumulative frames emitted by the fleet (on disarm: the
+            # just-stopped drivers' final count — the tile's flood volume)
+            "emitted": max(emitted, sum(d.emitted for d in adv_drivers)),
+        }
+
     # park until the parent closes our stdin; lines that parse as JSON
-    # commands are live controls (weather swaps), everything else ignored
+    # commands are live controls (weather swaps, adversary arming,
+    # scenario-tile observability), everything else ignored
     while True:
         line = sys.stdin.readline()
         if not line:
@@ -220,6 +309,24 @@ def main() -> None:
                 json.dumps({"ok": "netem", "profile": cmd.get("profile", "lan")}),
                 flush=True,
             )
+        elif cmd.get("cmd") == "adversary":
+            try:
+                print(
+                    json.dumps(
+                        _adversary(bool(cmd.get("active")), cmd.get("schedule"))
+                    ),
+                    flush=True,
+                )
+            except Exception as e:  # noqa: BLE001 - parent sees the ack
+                print(json.dumps({"err": f"adversary: {e!r}"}), flush=True)
+        elif cmd.get("cmd") == "scenario":
+            if node.health is None:
+                print(json.dumps({"err": "health not enabled"}), flush=True)
+                continue
+            node.health.registry.set_scenario(cmd.get("info"))
+            print(json.dumps({"ok": "scenario"}), flush=True)
+    for d in adv_drivers:
+        d.stop()
     node.stop()
 
 

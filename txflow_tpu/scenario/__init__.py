@@ -1,0 +1,40 @@
+"""Scenario grid: composed Byzantine × WAN × overload × stake weather
+over real-TCP ProcNets, with a banked results matrix.
+
+- ``spec``: declarative axes × levels, seed-deterministic per-axis
+  schedules (disjoint PRNG domains — the composition property);
+- ``harness``: the shared soak/grid assertion core (zero admitted-tx
+  loss, committed-set equality, SLO, quarantine) + the ``RESULT`` line /
+  typed-exit-code contract every soak mode reports through;
+- ``runner``: walks tiles over shared live nets and judges each;
+- ``bank``: the results-matrix artifact under the clean-supersede
+  contract (``bench_artifacts/scenario_grid_latest.json``).
+
+``tools/scenario_grid.py`` is the CLI (``--list``/``--dry-run``/
+``--smoke``/``--full``); tools/soak.py's three modes are single-axis
+ancestors rebuilt on the same harness.
+"""
+
+from .harness import BREACH_CLASSES, EXIT_CODES, Breach, emit_result, worst_breach
+from .spec import AXES, GridSpec, TilePlan, TileSpec, axis_seed
+from .runner import GridRunner
+from .bank import GRID_LATEST, bank_matrix, build_matrix, load_banked, verdict_fingerprint
+
+__all__ = [
+    "AXES",
+    "BREACH_CLASSES",
+    "Breach",
+    "EXIT_CODES",
+    "GRID_LATEST",
+    "GridRunner",
+    "GridSpec",
+    "TilePlan",
+    "TileSpec",
+    "axis_seed",
+    "bank_matrix",
+    "build_matrix",
+    "emit_result",
+    "load_banked",
+    "verdict_fingerprint",
+    "worst_breach",
+]

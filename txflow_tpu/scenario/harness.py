@@ -1,0 +1,388 @@
+"""Shared soak/grid harness: real-TCP bring-up, the zero-loss /
+set-equality / prefix-stability / quarantine assertion core, and the
+machine-readable run report every mode emits.
+
+Before this module, ``tools/soak.py --overload``, ``--wan-matrix`` and
+``--byzantine`` each carried a private copy of "start a ProcNet, probe it
+over RPC, judge admitted-tx loss and cross-node agreement, print a
+banner and exit 1" — and the scenario grid would have been the fourth.
+Every mode (and every grid tile) now judges through one set of helpers,
+raising :class:`Breach` with a typed breach class; the CLI edge turns
+that into one final ``RESULT {...}`` JSON line plus a distinct exit code
+per class, so callers stop grepping log text for ``SOAK STALL`` markers.
+
+Exit-code contract (stable; scripts may match on it):
+
+==================  ====  ==============================================
+breach class        exit  meaning
+==================  ====  ==============================================
+(ok)                   0  every assertion held
+``infra``              1  harness/environment failure (legacy catch-all)
+``loss``              10  an admitted tx never committed somewhere
+``divergence``        11  cross-node committed sets unequal, or a node
+                          rewrote its committed prefix
+``slo``               12  a latency budget breached
+``adversary``         13  an adversary was not struck/quarantined, or
+                          post-quarantine waste exceeded its bound
+``liveness``          14  the net never reached/settled a required state
+                          (mesh, height, sync, drain)
+==================  ====  ==============================================
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import statistics
+import sys
+import time
+import urllib.request
+from contextlib import contextmanager
+
+BREACH_CLASSES = ("infra", "loss", "divergence", "slo", "adversary", "liveness")
+
+EXIT_OK = 0
+EXIT_CODES: dict[str, int] = {
+    "infra": 1,
+    "loss": 10,
+    "divergence": 11,
+    "slo": 12,
+    "adversary": 13,
+    "liveness": 14,
+}
+
+# severity order for aggregating many tile verdicts into one exit code:
+# losing admitted txs outranks disagreeing, which outranks being slow
+BREACH_SEVERITY = ("loss", "divergence", "adversary", "liveness", "slo", "infra")
+
+
+class Breach(Exception):
+    """One failed soak/grid assertion, carrying its breach class."""
+
+    def __init__(self, kind: str, msg: str):
+        if kind not in BREACH_CLASSES:
+            raise ValueError(f"unknown breach class {kind!r}")
+        super().__init__(msg)
+        self.kind = kind
+        self.msg = msg
+
+
+def worst_breach(kinds) -> str | None:
+    """The most severe class among ``kinds`` (None when empty)."""
+    present = [k for k in BREACH_SEVERITY if k in set(kinds)]
+    return present[0] if present else None
+
+
+def emit_result(mode: str, ok: bool, breach: str | None = None,
+                detail: str = "", **summary) -> int:
+    """Print the one machine-readable final line every soak/grid mode
+    ends with, and return the exit code for it. The ``RESULT `` prefix
+    is the contract: exactly one such line per run, always last."""
+    code = EXIT_OK if ok else EXIT_CODES.get(breach or "infra", 1)
+    payload = {
+        "mode": mode,
+        "ok": ok,
+        "exit_code": code,
+        "breach": None if ok else (breach or "infra"),
+        "detail": detail,
+        **summary,
+    }
+    print("RESULT " + json.dumps(payload, sort_keys=True), flush=True)
+    return code
+
+
+def run_mode(mode: str, fn) -> None:
+    """CLI edge wrapper: run ``fn`` (which returns a summary dict on
+    success and raises Breach on a failed assertion), emit the banner +
+    RESULT line, and exit with the class's code. Unexpected exceptions
+    are an ``infra`` breach — the environment broke, not an SLO."""
+    try:
+        summary = fn() or {}
+    except Breach as b:
+        print(f"SOAK STALL: {b.msg}", flush=True)
+        sys.exit(emit_result(mode, False, b.kind, b.msg))
+    except KeyboardInterrupt:
+        raise
+    except Exception as e:  # noqa: BLE001 - the report IS the handler
+        print(f"SOAK STALL: harness failure: {e!r}", flush=True)
+        sys.exit(emit_result(mode, False, "infra", repr(e)))
+    sys.exit(emit_result(mode, True, **summary))
+
+
+# -- shared bring-up / teardown --------------------------------------------
+
+
+@contextmanager
+def live_net(n: int, spec: dict, timeout: float = 90.0):
+    """The real-TCP bring-up/teardown every soak mode shares: a started
+    ProcNet that is always stopped, however the mode exits. (The grid
+    runner manages net lifetime itself — one net outlives many tiles —
+    but judges through the same assertion core below.)"""
+    from ..node.procnet import ProcNet
+
+    net = ProcNet(n, spec=spec)
+    net.start(timeout=timeout)
+    try:
+        yield net
+    finally:
+        net.stop()
+
+
+# -- RPC probe helpers (everything over real sockets) ----------------------
+
+
+def commit_latency(net, i: int, tx: str, timeout: float = 10.0):
+    """Submit via ``broadcast_tx_commit``; ``(seconds-to-commit or None,
+    tx hash)``. None means slow, not necessarily lost: callers re-check
+    the hash at quiescence before calling it loss."""
+    host, port = net.rpc_addr(i)
+    t0 = time.monotonic()
+    with urllib.request.urlopen(
+        f'http://{host}:{port}/broadcast_tx_commit?tx="{tx}"'
+        f"&timeout={timeout}",
+        timeout=timeout + 5,
+    ) as r:
+        res = json.loads(r.read().decode())["result"]
+    lat = time.monotonic() - t0 if res.get("committed") else None
+    return lat, res["hash"]
+
+
+def broadcast(net, i: int, tx: str, timeout: float = 10.0) -> str:
+    """Fire-and-forget ``broadcast_tx``; returns the admitted hash."""
+    host, port = net.rpc_addr(i)
+    with urllib.request.urlopen(
+        f'http://{host}:{port}/broadcast_tx?tx="{tx}"', timeout=timeout
+    ) as r:
+        return json.loads(r.read().decode())["result"]["hash"]
+
+
+def percentiles(lats: list[float]) -> tuple[float, float]:
+    """(p50, p99) in ms; p99 is the max at soak-sized sample counts."""
+    return statistics.median(lats) * 1e3, max(lats) * 1e3
+
+
+# -- the assertion core ----------------------------------------------------
+
+
+def assert_all_committed(
+    net, hashes, nodes, deadline_s: float, what: str = "admitted txs",
+    kind: str = "loss",
+) -> None:
+    """Zero admitted-tx loss: every hash commits on EVERY listed node
+    before the deadline. Polls /tx; raises ``Breach(kind)`` naming the
+    nodes still missing txs."""
+    remaining = {i: set(hashes) for i in nodes}
+    deadline = time.monotonic() + deadline_s
+    while any(remaining.values()) and time.monotonic() < deadline:
+        for i in nodes:
+            remaining[i] = {
+                h
+                for h in remaining[i]
+                if not net.rpc_json(i, f"/tx?hash={h}")["result"]["committed"]
+            }
+        if any(remaining.values()):
+            time.sleep(0.4)
+    missing = {i: len(r) for i, r in remaining.items() if r}
+    if missing:
+        raise Breach(
+            kind,
+            f"{what}: {missing} never committed within {deadline_s:.0f}s "
+            f"(node -> missing count)",
+        )
+
+
+def commit_log_heads(net, nodes) -> dict[int, dict]:
+    """Per-node commit-log head digests (cheap ``count=0`` probes) for a
+    later prefix-stability check."""
+    return {i: net.rpc_json(i, "/commit_log?count=0")["result"] for i in nodes}
+
+
+def assert_prefix_stable(net, pre: dict[int, dict], label: str = "") -> None:
+    """No node may rewrite committed history: the log each node had when
+    ``pre`` was captured must be an exact prefix of its log now."""
+    tag = f"[{label}] " if label else ""
+    for i, head in pre.items():
+        res = net.rpc_json(i, f"/commit_log?start=0&count={head['total']}")[
+            "result"
+        ]
+        digest = hashlib.sha256()
+        for h in res["hashes"]:
+            digest.update(h.encode())
+        if digest.hexdigest() != head["digest"]:
+            raise Breach(
+                "divergence", f"{tag}node {i} rewrote its committed prefix"
+            )
+
+
+def assert_committed_sets_equal(
+    net, nodes, deadline_s: float, label: str = ""
+) -> list[dict]:
+    """Cross-node committed-SET equality (there is no global total order
+    across fast-path nodes — each node's log is its own decision order).
+    Returns the final per-node commit logs on success."""
+    deadline = time.monotonic() + deadline_s
+    logs: list[dict] = []
+    while time.monotonic() < deadline:
+        logs = [net.rpc_json(i, "/commit_log")["result"] for i in nodes]
+        sets = [frozenset(lg["hashes"]) for lg in logs]
+        if all(s == sets[0] for s in sets):
+            return logs
+        time.sleep(0.4)
+    tag = f"[{label}] " if label else ""
+    raise Breach(
+        "divergence",
+        f"{tag}committed sets diverged: totals "
+        f"{[lg['total'] for lg in logs]}",
+    )
+
+
+def assert_slo(p50_ms: float, p99_ms: float, p50_budget_ms: float,
+               p99_budget_ms: float, label: str = "") -> None:
+    tag = f"[{label}] " if label else ""
+    if p50_ms > p50_budget_ms:
+        raise Breach(
+            "slo",
+            f"{tag}commit p50 {p50_ms:.0f}ms breached the "
+            f"{p50_budget_ms:.0f}ms budget",
+        )
+    if p99_ms > p99_budget_ms:
+        raise Breach(
+            "slo",
+            f"{tag}commit p99 {p99_ms:.0f}ms breached the "
+            f"{p99_budget_ms:.0f}ms budget",
+        )
+
+
+# -- adversary judging (health/byzantine.py over RPC) ----------------------
+
+
+def byzantine_peer_state(net, i: int, peer_id: str) -> dict:
+    """One honest node's ledger record for ``peer_id`` (via /health)."""
+    byz = net.rpc_json(i, "/health")["result"].get("byzantine") or {}
+    return (byz.get("peers") or {}).get(peer_id) or {}
+
+
+def adversary_activity_marks(net, nodes, peer_id: str) -> dict[int, tuple]:
+    """Per-honest-node (strikes, quarantined-frame drops) counters for
+    the adversary — captured before a tile so judging can require real
+    DELTAS, not totals left over from an earlier tile on the same net."""
+    marks = {}
+    for i in nodes:
+        st = byzantine_peer_state(net, i, peer_id)
+        marks[i] = (
+            st.get("strikes", 0),
+            (st.get("drops") or {}).get("quarantined", 0),
+        )
+    return marks
+
+
+def wait_quarantined(net, nodes, peer_id: str, deadline_s: float,
+                     label: str = "") -> None:
+    """Block until every listed honest node quarantines ``peer_id``.
+    Used right after arming, BEFORE offered load starts: a busy net has
+    the adversary relaying honest votes, and those valid relays race its
+    bad fraction away from the breaker line — armed-and-quiet, the
+    garbage dominates and the latch trips in a round-trip or two."""
+    tag = f"[{label}] " if label else ""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        states = {i: byzantine_peer_state(net, i, peer_id) for i in nodes}
+        if all(s.get("quarantined") for s in states.values()):
+            return
+        if time.monotonic() > deadline:
+            lagging = [i for i, s in states.items() if not s.get("quarantined")]
+            raise Breach(
+                "adversary",
+                f"{tag}{peer_id} never quarantined on nodes {lagging}",
+            )
+        time.sleep(0.2)
+
+
+def assert_adversary_quarantined(
+    net, nodes, peer_id: str, marks: dict[int, tuple],
+    deadline_s: float, label: str = "",
+) -> dict:
+    """Every honest node must (a) currently quarantine ``peer_id`` and
+    (b) show fresh evidence the flood was live this tile: a strike delta
+    (garbage reached verdicts) or quarantined-frame-drop delta (the
+    front-door gate absorbed it pre-decode). Returns a summary dict."""
+    tag = f"[{label}] " if label else ""
+    deadline = time.monotonic() + deadline_s
+    states: dict[int, dict] = {}
+    while True:
+        states = {i: byzantine_peer_state(net, i, peer_id) for i in nodes}
+        if all(s.get("quarantined") for s in states.values()):
+            break
+        if time.monotonic() > deadline:
+            lagging = [i for i, s in states.items() if not s.get("quarantined")]
+            raise Breach(
+                "adversary",
+                f"{tag}{peer_id} never quarantined on nodes {lagging}",
+            )
+        time.sleep(0.3)
+    while True:
+        states = {i: byzantine_peer_state(net, i, peer_id) for i in nodes}
+        deltas = {
+            i: (
+                s.get("strikes", 0) - marks[i][0],
+                (s.get("drops") or {}).get("quarantined", 0) - marks[i][1],
+            )
+            for i, s in states.items()
+        }
+        if all(ds > 0 or dq > 0 for ds, dq in deltas.values()):
+            break
+        if time.monotonic() > deadline:
+            idle = [i for i, d in deltas.items() if max(d) <= 0]
+            raise Breach(
+                "adversary",
+                f"{tag}{peer_id} quarantined but nodes {idle} saw no fresh "
+                f"strikes or gated drops — was the flood live?",
+            )
+        time.sleep(0.3)
+    return {
+        "peer": peer_id,
+        "strike_deltas": {i: d[0] for i, d in deltas.items()},
+        "gated_drop_deltas": {i: d[1] for i, d in deltas.items()},
+    }
+
+
+# -- liveness helpers ------------------------------------------------------
+
+
+def wait_height(net, nodes, height: int, deadline_s: float,
+                field: str = "fast_path_height", label: str = "") -> None:
+    """Wait for every listed node's /health progress ``field`` to reach
+    ``height``; liveness breach past the deadline."""
+    deadline = time.monotonic() + deadline_s
+    heights: dict[int, int] = {}
+    while time.monotonic() < deadline:
+        heights = {
+            i: (net.rpc_json(i, "/health")["result"].get("progress") or {}).get(
+                field
+            )
+            or 0
+            for i in nodes
+        }
+        if all(h >= height for h in heights.values()):
+            return
+        time.sleep(0.2)
+    tag = f"[{label}] " if label else ""
+    raise Breach(
+        "liveness",
+        f"{tag}{field} never reached {height} everywhere: {heights}",
+    )
+
+
+def wait_mesh(net, nodes, min_peers: int, deadline_s: float,
+              label: str = "") -> None:
+    deadline = time.monotonic() + deadline_s
+    n_peers: list[int] = []
+    while time.monotonic() < deadline:
+        n_peers = [
+            net.rpc_json(i, "/net_info")["result"]["n_peers"] for i in nodes
+        ]
+        if all(p >= min_peers for p in n_peers):
+            return
+        time.sleep(0.4)
+    tag = f"[{label}] " if label else ""
+    raise Breach("liveness", f"{tag}mesh never (re)formed: peers {n_peers}")

@@ -1,0 +1,481 @@
+"""Scenario-grid runner: walk tiles over live real-TCP ProcNets and
+judge each one pass/fail.
+
+One net serves every tile that shares a ``net_signature`` (the stake
+table is fixed at bring-up; weather swaps live via ``set_netem``, the
+adversary arms/disarms via ``set_adversary``, offered load is the
+parent's flood threads, and churn rides committed ``val:`` txs) — so a
+12-tile walk costs a handful of bring-ups, not twelve. Tiles are judged
+INDEPENDENTLY: a failed tile records its breach and the walk continues,
+so one bad tile yields a matrix with one red cell instead of a dead run.
+
+Per-tile judgment (the four acceptance gates, all over real sockets):
+
+- zero admitted-tx loss: every hash the net admitted (priority probes
+  AND bulk riders) commits on every node before the drain deadline;
+- cross-node committed-set equality, plus no node rewriting the
+  committed prefix it entered the tile with;
+- per-lane SLO: priority-probe p50/p99 against the tile's weather-
+  profile budgets scaled by its overload/stake relief (and the
+  ``SOAK_P50_BUDGET_MS`` / ``SOAK_BUDGET_SCALE`` relief valves for
+  heavily-shared boxes);
+- adversary quarantine: every honest node quarantines the adversary AND
+  shows fresh strike/gated-drop deltas from THIS tile's flood.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..admission.config import soak_spec_overrides
+from . import harness as H
+from .spec import GridSpec, TileSpec
+
+# the byzantine soak's production-shaped breaker posture (tools/soak.py
+# --byzantine): armed from t=0, strike_penalty 0 so the scoreboard floor
+# never tears down links mid-tile — link churn is the overload axis's
+# subject, not the adversary axis's.
+#
+# quarantine_replays stays OFF here, unlike the byzantine soak: grid
+# nets regossip aggressively over shaped WAN links, and while a flood
+# backlog drains every re-walk re-sends vote signatures peers already
+# hold. With replays counted as breaker-bad, HONEST peers cross the
+# 0.5-bad-rate line within one overload tile and the whole mesh
+# quarantines itself (observed live: 4/4 nodes mutually quarantined,
+# zero commits for 600 s). The adversary axis does not need the replay
+# breaker to be convicted — sig-garbage and stale-vote traffic trips
+# the bad gate, and forged signatures draw engine invalid-verdict
+# strikes.
+GRID_BYZANTINE_POSTURE = {
+    "min_samples": 24,
+    "max_bad_rate": 0.5,
+    "stale_height_slack": 8,
+    "quarantine_replays": False,
+    "quarantine_secs": 600.0,
+    "strike_penalty": 0.0,
+    "quarantine_penalty": 0.5,
+}
+
+
+class _Flood:
+    """Parent-side bulk offered load: ``threads`` loops hammering the
+    honest nodes' /broadcast_tx at the tile's drawn pacing. Admitted
+    hashes are collected (they join the zero-loss set); 429 sheds are
+    counted but shed traffic owes nothing."""
+
+    def __init__(self, net, nodes, schedule: dict, tile_id: str):
+        self.net = net
+        self.nodes = list(nodes)
+        self.schedule = schedule
+        self.tag = schedule.get("tag", 0)
+        self.tile_id = tile_id
+        self.admitted: list[str] = []
+        self.shed = 0
+        self._mtx = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> None:
+        for t, interval in enumerate(self.schedule.get("intervals", [])):
+            th = threading.Thread(
+                target=self._run,
+                args=(t, float(interval)),
+                name=f"grid-flood-{t}",
+                daemon=True,
+            )
+            self._threads.append(th)
+            th.start()
+
+    def _run(self, t: int, interval: float) -> None:
+        seq = 0
+        while not self._stop.is_set():
+            node = self.nodes[(t + seq) % len(self.nodes)]
+            tx = "grid-bulk-%d-%d-%d=v" % (self.tag, t, seq)
+            seq += 1
+            try:
+                h = H.broadcast(self.net, node, tx, timeout=5.0)
+                with self._mtx:
+                    self.admitted.append(h)
+            except Exception:
+                # 429 shed (or a transient socket error): not admitted,
+                # so it owes no commit
+                with self._mtx:
+                    self.shed += 1
+            self._stop.wait(interval)
+
+    def stop(self) -> tuple[list[str], int]:
+        self._stop.set()
+        for th in self._threads:
+            th.join(timeout=10.0)
+        with self._mtx:
+            return list(self.admitted), self.shed
+
+
+class _Churner:
+    """Stake-churn driver: injects the tile's drawn ``val:`` re-weights
+    (kvstore -> EndBlock -> H+2 restage) at their scheduled fractions of
+    the tile window. Retries 429 sheds — churn is control-plane traffic
+    and must land even mid-flood."""
+
+    def __init__(self, net, nodes, events, pub_hexes, duration: float):
+        self.net = net
+        self.nodes = list(nodes)
+        self.events = sorted(events, key=lambda e: e["at_frac"])
+        self.pub_hexes = pub_hexes
+        self.duration = duration
+        self.landed: list[str] = []  # admitted val: tx hashes
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="grid-churn", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        t0 = time.monotonic()
+        for k, ev in enumerate(self.events):
+            at = max(0.0, float(ev["at_frac"])) * self.duration
+            while time.monotonic() - t0 < at:
+                if self._stop.wait(0.1):
+                    return
+            tx = "val:%s!%d" % (self.pub_hexes[int(ev["validator"])], int(ev["power"]))
+            while not self._stop.is_set():
+                try:
+                    h = H.broadcast(
+                        self.net, self.nodes[k % len(self.nodes)], tx, timeout=5.0
+                    )
+                    self.landed.append(h)
+                    break
+                except Exception:
+                    if self._stop.wait(0.3):
+                        return
+
+    def stop(self) -> list[str]:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        return list(self.landed)
+
+
+class GridRunner:
+    """Walks a tile list over shared ProcNets and returns one verdict
+    record per tile (see ``run``)."""
+
+    def __init__(
+        self,
+        grid: GridSpec,
+        smoke: bool = True,
+        log=print,
+        data_root: str | None = None,
+    ):
+        self.grid = grid
+        self.smoke = smoke
+        self.log = log
+        self.data_root = data_root
+        # knobs (seconds); smoke keeps CI inside the tier-1 budget, the
+        # full posture is the offline soak's
+        self.tile_duration = 4.0 if smoke else 20.0
+        self.commit_wait = float(
+            os.environ.get("SOAK_COMMIT_WAIT", "25" if smoke else "120")
+        )
+        self.quarantine_wait = 20.0 if smoke else 60.0
+        self.probe_interval = 0.25 if smoke else 0.5
+        # per-probe server-side wait: a slow probe is counted (and its
+        # hash re-checked at quiescence), never allowed to wedge the tile
+        self.probe_timeout = 8.0 if smoke else 20.0
+        # relief valves shared with the soaks: heavily-loaded boxes scale
+        # budgets up rather than turning contention into red tiles
+        self.budget_scale_env = float(os.environ.get("SOAK_BUDGET_SCALE", "1"))
+        self.p50_floor_ms = float(os.environ.get("SOAK_P50_BUDGET_MS", "0"))
+
+    # -- net lifecycle (one net per net_signature group) --
+
+    def _spec_for(self, plan) -> dict:
+        n = self.grid.n_validators
+        spec: dict = {
+            "chain_id": "txflow-grid",
+            "seed_prefix": f"grid-{self.grid.seed}-{plan.tile.stake}",
+            "powers": list(plan.stake["powers"]),
+            # the block path runs only where churn needs it (churning
+            # stake tiles: val: txs -> EndBlock -> H+2 restage). On a
+            # consensus net /commit_log stops being the complete commit
+            # record — a tx block-committed before its fast-path quorum
+            # lands never gets an S: row, and which path wins races
+            # differently per node — so the fast-path equality gates are
+            # judged on the fast-path-only groups and consensus nets are
+            # judged on owed-set coverage + block liveness instead.
+            "consensus": plan.consensus,
+            "byzantine": dict(GRID_BYZANTINE_POSTURE),
+            "admission": soak_spec_overrides(),
+            "mempool": {"size": 300, "cache_size": 20000},
+            # scalar host verify: small batches keep head-of-line blocking
+            # out of the priority drain (the overload soak's sizing)
+            "engine": {"max_batch": 8, "min_batch": 1},
+            "netem": {
+                "profile": plan.weather["profile"],
+                "seed": plan.weather["shaper_seed"],
+            },
+            "regossip": 0.25,
+        }
+        if self.data_root:
+            spec["per_node"] = {
+                i: {"data_dir": f"{self.data_root}/{plan.tile.stake}/node{i}"}
+                for i in range(n)
+            }
+        return spec
+
+    def _bring_up(self, plan):
+        from ..node.procnet import ProcNet
+
+        net = ProcNet(self.grid.n_validators, spec=self._spec_for(plan))
+        net.start(timeout=90.0)
+        return net
+
+    def _pub_hexes(self, plan) -> list[str]:
+        import hashlib as _h
+
+        from ..types.priv_validator import MockPV
+
+        prefix = f"grid-{self.grid.seed}-{plan.tile.stake}"
+        return [
+            MockPV(_h.sha256(f"{prefix}-val{i}".encode()).digest())
+            .get_pub_key()
+            .hex()
+            for i in range(self.grid.n_validators)
+        ]
+
+    # -- the walk --
+
+    def run(self, tiles: list[TileSpec]) -> list[dict]:
+        """Run ``tiles`` (grouped by net signature, walk order otherwise
+        preserved) and return one verdict dict per tile, in the original
+        tile order."""
+        plans = [self.grid.materialize(t) for t in tiles]
+        groups: dict[tuple, list[int]] = {}
+        for idx, plan in enumerate(plans):
+            groups.setdefault(plan.net_signature, []).append(idx)
+        verdicts: dict[int, dict] = {}
+        total = len(tiles)
+        for sig, idxs in groups.items():
+            net = None
+            try:
+                self.log(
+                    f"grid: bringing up {self.grid.n_validators}-process net "
+                    f"for {sig[0]}={sig[1]} ({len(idxs)} tiles)"
+                )
+                net = self._bring_up(plans[idxs[0]])
+                for idx in idxs:
+                    verdicts[idx] = self._run_tile(
+                        net, plans[idx], idx, total
+                    )
+            except Exception as e:  # bring-up/teardown infra failure:
+                # every unjudged tile in the group records it
+                for idx in idxs:
+                    if idx not in verdicts:
+                        verdicts[idx] = self._verdict(
+                            plans[idx], False, "infra", f"net: {e!r}"
+                        )
+            finally:
+                if net is not None:
+                    net.stop()
+        return [verdicts[i] for i in range(total)]
+
+    def _verdict(self, plan, ok: bool, breach: str | None, detail: str, **extra) -> dict:
+        return {
+            "tile": plan.tile.tile_id,
+            "axes": plan.tile.axes_dict(),
+            "composed": plan.tile.composed,
+            "pass": ok,
+            "breach": breach,
+            "detail": detail,
+            **extra,
+        }
+
+    def _run_tile(self, net, plan, idx: int, total: int) -> dict:
+        tile = plan.tile
+        nodes = list(range(self.grid.n_validators))
+        adv_idx = plan.adversary_index
+        honest = [i for i in nodes if i != adv_idx]
+        self.log(f"grid: tile {idx + 1}/{total} {tile.tile_id}")
+        t0 = time.monotonic()
+        flood = None
+        churner = None
+        armed = False
+        try:
+            net.set_netem(plan.weather["profile"])
+            net.set_scenario(
+                {
+                    "active": True,
+                    "tile": tile.tile_id,
+                    "tile_index": idx,
+                    "tiles_total": total,
+                    "started_unix": time.time(),
+                    "axes": tile.axes_dict(),
+                }
+            )
+            pre_heads = H.commit_log_heads(net, nodes)
+            marks = (
+                H.adversary_activity_marks(
+                    net, honest, net.infos[adv_idx]["node_id"]
+                )
+                if adv_idx is not None
+                else {}
+            )
+            if adv_idx is not None:
+                net.set_adversary(adv_idx, True, schedule=plan.adversary)
+                armed = True
+                # conviction must land while the net is still quiet: once
+                # offered load starts, the (disarmed-signer) adversary
+                # RELAYS honest votes, and those valid frames race its
+                # judged-bad fraction back under the breaker line. Armed-
+                # and-quiet the garbage dominates within a round-trip or
+                # two, and once the latch trips, relays are gated at the
+                # front door and stop counting as good events — the
+                # verdict is then stable for the whole tile.
+                H.wait_quarantined(
+                    net, honest, net.infos[adv_idx]["node_id"],
+                    self.quarantine_wait, label=tile.tile_id,
+                )
+            flood = _Flood(net, honest, plan.overload, tile.tile_id)
+            flood.start()
+            if plan.stake.get("churn"):
+                churner = _Churner(
+                    net,
+                    honest,
+                    plan.stake["churn"],
+                    self._pub_hexes(plan),
+                    self.tile_duration,
+                )
+                churner.start()
+
+            # priority probes: the tile's latency sample AND its zero-loss
+            # sentinels; fee=1 rides the priority lane past any shed
+            lats: list[float] = []
+            slow_probes = 0
+            probe_hashes: list[str] = []
+            seq = 0
+            t_load = time.monotonic()  # the latch wait is not tile time
+            while time.monotonic() - t_load < self.tile_duration:
+                node = honest[seq % len(honest)]
+                tx = f"fee=1;grid-probe-{idx}-{seq}=v"
+                seq += 1
+                lat, h = H.commit_latency(
+                    net, node, tx, timeout=self.probe_timeout
+                )
+                probe_hashes.append(h)
+                if lat is None:
+                    slow_probes += 1
+                else:
+                    lats.append(lat)
+                time.sleep(self.probe_interval)
+
+            # quiesce offered load, then judge
+            riders, shed = flood.stop()
+            flood = None
+            churn_hashes = churner.stop() if churner is not None else []
+            churner = None
+            adv_summary = {}
+            if adv_idx is not None:
+                adv_summary = H.assert_adversary_quarantined(
+                    net,
+                    honest,
+                    net.infos[adv_idx]["node_id"],
+                    marks,
+                    self.quarantine_wait,
+                    label=tile.tile_id,
+                )
+                ack = net.set_adversary(adv_idx, False)
+                armed = False
+                adv_summary["emitted"] = ack.get("emitted", 0)
+
+            owed = probe_hashes + riders + churn_hashes
+            H.assert_all_committed(
+                net, owed, nodes, self.commit_wait,
+                what=f"[{tile.tile_id}] admitted txs",
+            )
+            H.assert_prefix_stable(net, pre_heads, label=tile.tile_id)
+            if not plan.consensus:
+                # fast-path-only net: /commit_log IS the complete commit
+                # record, so cross-node committed-SET equality holds
+                H.assert_committed_sets_equal(
+                    net, nodes, self.commit_wait, label=tile.tile_id
+                )
+            else:
+                # consensus net: agreement is the block path's total
+                # order; judge that it stayed LIVE through the churn
+                # (owed-set coverage above already pins zero loss)
+                base = min(
+                    (
+                        net.rpc_json(i, "/health")["result"].get("progress")
+                        or {}
+                    ).get("consensus_height")
+                    or 0
+                    for i in nodes
+                )
+                H.wait_height(
+                    net, nodes, base + 2, self.commit_wait,
+                    field="consensus_height", label=tile.tile_id,
+                )
+
+            if not lats:
+                raise H.Breach(
+                    "liveness",
+                    f"[{tile.tile_id}] no probe committed inside its window",
+                )
+            p50, p99 = H.percentiles(lats)
+            scale = plan.budget_scale * self.budget_scale_env
+            p50_budget = max(
+                plan.weather["p50_budget_ms"] * scale, self.p50_floor_ms
+            )
+            p99_budget = max(
+                plan.weather["p99_budget_ms"] * scale, 2 * self.p50_floor_ms
+            )
+            H.assert_slo(p50, p99, p50_budget, p99_budget, label=tile.tile_id)
+
+            return self._verdict(
+                plan,
+                True,
+                None,
+                "",
+                probes=len(probe_hashes),
+                slow_probes=slow_probes,
+                riders=len(riders),
+                shed=shed,
+                churn_events=len(churn_hashes),
+                p50_ms=round(p50, 1),
+                p99_ms=round(p99, 1),
+                p50_budget_ms=round(p50_budget, 1),
+                p99_budget_ms=round(p99_budget, 1),
+                adversary=adv_summary,
+                duration_s=round(time.monotonic() - t0, 1),
+            )
+        except H.Breach as b:
+            self.log(f"grid: tile {tile.tile_id} FAILED [{b.kind}]: {b.msg}")
+            return self._verdict(
+                plan, False, b.kind, b.msg,
+                duration_s=round(time.monotonic() - t0, 1),
+            )
+        except Exception as e:  # noqa: BLE001 - tile-scoped infra failure
+            self.log(f"grid: tile {tile.tile_id} infra failure: {e!r}")
+            return self._verdict(
+                plan, False, "infra", repr(e),
+                duration_s=round(time.monotonic() - t0, 1),
+            )
+        finally:
+            if flood is not None:
+                flood.stop()
+            if churner is not None:
+                churner.stop()
+            if armed:
+                try:
+                    net.set_adversary(adv_idx, False)
+                except Exception:
+                    pass
+            try:
+                net.set_scenario(None)
+            except Exception:
+                pass

@@ -1,0 +1,379 @@
+"""Declarative scenario-grid spec: axes × levels, seed-deterministic.
+
+The ROADMAP's "scenario grid" frontier: the repo owns four independent
+stress axes — adversary fleet (faults/byzantine.py), WAN weather
+(netem/), overload flood + admission (admission/), stake distributions +
+churn (faults/stake.py, "Weighted Voting on the Blockchain" arxiv
+1903.04213) — and production meets them simultaneously. A ``TileSpec``
+names one level per axis; ``GridSpec`` enumerates a configured
+cross-product (or the smoke diagonal) and ``materialize`` turns a tile
+into the concrete, seed-deterministic schedules each axis contributes.
+
+PRNG-domain discipline (the FaultPlan/LinkShaper rule, composed): every
+axis draws its schedule from its OWN stream seeded by
+``sha256("scenario|<seed>|<axis>|<level>")``. No draw ever crosses axes,
+so toggling one axis's level leaves every other axis's drawn schedule
+byte-identical — the composition property tests/test_scenario_grid.py
+pins. Never share one Random across axes when extending this module.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import random
+from dataclasses import dataclass, field
+
+from ..faults.stake import stake_distribution
+from ..netem.profiles import PROFILES, profile_names
+
+# Axis order is part of the spec: tile ids, cross-product walk order and
+# the smoke diagonal all derive from it. The FIRST level of each axis is
+# its baseline (the unstressed control level).
+ADVERSARY_LEVELS = ("none", "flooder", "fleet")
+WEATHER_LEVELS = profile_names()  # lan first: the baseline profile
+OVERLOAD_LEVELS = ("none", "surge", "flood")
+STAKE_LEVELS = ("uniform", "whale", "longtail", "churning")
+
+AXES: dict[str, tuple[str, ...]] = {
+    "adversary": ADVERSARY_LEVELS,
+    "weather": WEATHER_LEVELS,
+    "overload": OVERLOAD_LEVELS,
+    "stake": STAKE_LEVELS,
+}
+
+# overload offered-load shape per level: flood thread count and the
+# worst-case SLO relief the extra contention buys (budget multiplier).
+# The pacing interval itself is DRAWN from the overload domain so the
+# schedule is a real per-level PRNG artifact, not just a constant table.
+_OVERLOAD_SHAPE = {
+    "none": dict(threads=0, budget_scale=1.0),
+    "surge": dict(threads=2, budget_scale=2.0),
+    "flood": dict(threads=4, budget_scale=3.0),
+}
+
+# adversary driver mixes per level (faults/byzantine.py fleet). Batch /
+# interval bounds are drawn per driver from the adversary domain. The
+# fleet deliberately does NOT include the "stale" spammer: its lag-1000
+# votes clamp to height 0 on a fresh fast-path net, so honest pre-checks
+# judge them VALID — they only pad the breaker window with good events
+# and dilute the fleet's own bad fraction below the trip line. The
+# unknown-signer flood is the undiluted replacement: dropped at the
+# pre-check (unknown validator), one bad window event per vote.
+_ADVERSARY_MIX = {
+    "none": (),
+    "flooder": ("sig-garbage",),
+    "fleet": ("sig-garbage", "unknown-signer", "replayer"),
+}
+
+# per-stake-level SLO relief: churning runs block consensus + live
+# rotations alongside the fast path, which costs real latency on a
+# contended box
+_STAKE_BUDGET_SCALE = {"churning": 1.5}
+
+
+def axis_seed(seed: int, axis: str, level: str) -> int:
+    """The disjoint PRNG domain for one (grid seed, axis, level): no two
+    axes — and no two levels of one axis — ever share a stream."""
+    digest = hashlib.sha256(
+        b"scenario|%d|%s|%s" % (seed, axis.encode(), level.encode())
+    ).digest()
+    return int.from_bytes(digest[:8], "little")
+
+
+def axis_rng(seed: int, axis: str, level: str) -> random.Random:
+    return random.Random(axis_seed(seed, axis, level))
+
+
+@dataclass(frozen=True)
+class TileSpec:
+    """One grid tile: a level per axis plus the grid seed."""
+
+    adversary: str = "none"
+    weather: str = "lan"
+    overload: str = "none"
+    stake: str = "uniform"
+    seed: int = 0
+
+    def __post_init__(self):
+        for axis, levels in AXES.items():
+            level = getattr(self, axis)
+            if level not in levels:
+                raise ValueError(
+                    f"unknown {axis} level {level!r} (want one of {levels})"
+                )
+
+    @property
+    def tile_id(self) -> str:
+        return (
+            f"adv={self.adversary}|wan={self.weather}"
+            f"|load={self.overload}|stake={self.stake}"
+        )
+
+    def level(self, axis: str) -> str:
+        return getattr(self, axis)
+
+    @property
+    def composed(self) -> bool:
+        """Every axis off its baseline: the production-weather shape no
+        single-axis soak ever exercised."""
+        return all(
+            self.level(axis) != levels[0] for axis, levels in AXES.items()
+        )
+
+    def axes_dict(self) -> dict[str, str]:
+        return {axis: self.level(axis) for axis in AXES}
+
+
+@dataclass(frozen=True)
+class GridSpec:
+    """A configured grid: which levels of which axes, over how many
+    validators, under which seed. ``axes`` may restrict levels (a spec
+    file naming two weather profiles walks a 2-wide weather axis) but
+    never invent new ones."""
+
+    seed: int = 0
+    n_validators: int = 4
+    axes: dict[str, tuple[str, ...]] = field(
+        default_factory=lambda: {a: tuple(ls) for a, ls in AXES.items()}
+    )
+
+    def __post_init__(self):
+        if self.n_validators < 4:
+            # an adversary tile disarms one validator's signer; the
+            # remaining honest stake must still clear 2n/3 on its own
+            raise ValueError("scenario grids need >= 4 validators")
+        for axis, levels in self.axes.items():
+            if axis not in AXES:
+                raise ValueError(f"unknown axis {axis!r} (want {tuple(AXES)})")
+            bad = [lv for lv in levels if lv not in AXES[axis]]
+            if bad:
+                raise ValueError(f"unknown {axis} levels {bad}")
+            if not levels:
+                raise ValueError(f"axis {axis!r} has no levels")
+        for axis in AXES:
+            if axis not in self.axes:
+                raise ValueError(f"spec is missing axis {axis!r}")
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "GridSpec":
+        axes = {a: tuple(ls) for a, ls in AXES.items()}
+        axes.update({a: tuple(ls) for a, ls in (d.get("axes") or {}).items()})
+        return cls(
+            seed=int(d.get("seed", 0)),
+            n_validators=int(d.get("n_validators", 4)),
+            axes=axes,
+        )
+
+    @classmethod
+    def from_json_file(cls, path: str) -> "GridSpec":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def _tile(self, levels: dict[str, str]) -> TileSpec:
+        return TileSpec(seed=self.seed, **levels)
+
+    def full_tiles(self) -> list[TileSpec]:
+        """The configured cross-product, walked in axis order (adversary
+        outermost). This is the offline soak; CI runs the diagonal."""
+        names = list(AXES)
+        return [
+            self._tile(dict(zip(names, combo)))
+            for combo in itertools.product(*(self.axes[a] for a in names))
+        ]
+
+    def smoke_diagonal(self) -> list[TileSpec]:
+        """One bounded walk covering every level of every axis at least
+        once: tile k takes level ``k mod len(levels)`` on each axis, for
+        k in [0, max axis width). With the default axes, tile 1 composes
+        all four axes off-baseline — the acceptance tile."""
+        width = max(len(levels) for levels in self.axes.values())
+        return [
+            self._tile(
+                {a: self.axes[a][k % len(self.axes[a])] for a in AXES}
+            )
+            for k in range(width)
+        ]
+
+    # -- materialization: tile -> per-axis concrete schedules --
+
+    def materialize(self, tile: TileSpec) -> "TilePlan":
+        """Draw the tile's concrete schedules, one disjoint PRNG domain
+        per axis. Everything returned is JSON-serializable: the
+        byte-stability contract is over ``json.dumps`` of each schedule."""
+        return TilePlan(
+            tile=tile,
+            adversary=_adversary_schedule(tile, self.n_validators),
+            weather=_weather_schedule(tile),
+            overload=_overload_schedule(tile),
+            stake=_stake_schedule(tile, self.n_validators),
+        )
+
+
+@dataclass(frozen=True)
+class TilePlan:
+    """A materialized tile: the four drawn schedules plus derived run
+    facts (net signature, budgets) the runner consumes."""
+
+    tile: TileSpec
+    adversary: dict
+    weather: dict
+    overload: dict
+    stake: dict
+
+    def schedules(self) -> dict[str, dict]:
+        return {
+            "adversary": self.adversary,
+            "weather": self.weather,
+            "overload": self.overload,
+            "stake": self.stake,
+        }
+
+    @property
+    def net_signature(self) -> tuple:
+        """Tiles with equal signatures can share one live ProcNet: the
+        stake table (and whether consensus must run for churn) is fixed
+        at bring-up; weather, adversary activity and offered load all
+        swap live."""
+        return ("stake", self.tile.stake)
+
+    @property
+    def consensus(self) -> bool:
+        """Churn re-weights validators through committed blocks (kvstore
+        ``val:`` txs -> EndBlock -> H+2 rule), so churning tiles run the
+        block path alongside the fast path."""
+        return bool(self.stake.get("churn"))
+
+    @property
+    def budget_scale(self) -> float:
+        return float(self.overload["budget_scale"]) * float(
+            self.stake.get("budget_scale", 1.0)
+        )
+
+    @property
+    def adversary_index(self) -> int | None:
+        """The validator index that turns adversarial for this tile, or
+        None for adversary-free tiles. Drawn from the STAKE schedule
+        (smallest stake) so quorum reachability stays a property of the
+        stake table, not of which adversary level happens to be active."""
+        if self.adversary["level"] == "none":
+            return None
+        return int(self.stake["adversary_index"])
+
+
+def _adversary_schedule(tile: TileSpec, n_validators: int) -> dict:
+    level = tile.adversary
+    if level == "none":
+        return {"level": "none", "drivers": []}
+    rng = axis_rng(tile.seed, "adversary", level)
+    # forgeries target ghost txs (never in any mempool) so garbage
+    # signatures reach live verify verdicts instead of late-dropping
+    # against committed txs — the byzantine soak's trick, drawn here
+    ghosts = [
+        b"scn-ghost-%d-%d" % (i, rng.randrange(1 << 30)) for i in range(6)
+    ]
+    drivers = []
+    for kind in _ADVERSARY_MIX[level]:
+        if kind == "sig-garbage":
+            drivers.append(
+                {
+                    "kind": kind,
+                    "seed": rng.randrange(1 << 30),
+                    "batch": rng.randrange(6, 12),
+                    "interval": round(rng.uniform(0.02, 0.05), 4),
+                }
+            )
+        elif kind == "unknown-signer":
+            drivers.append(
+                {
+                    "kind": kind,
+                    "seed": rng.randrange(1 << 30),
+                    "batch": rng.randrange(8, 14),
+                    "interval": round(rng.uniform(0.02, 0.05), 4),
+                }
+            )
+        elif kind == "replayer":
+            drivers.append(
+                {
+                    "kind": kind,
+                    # replays are honest-signed ghost votes: the signer is
+                    # drawn from the honest validators (never the
+                    # adversary's own disarmed key)
+                    "signer_index": rng.randrange(1, n_validators),
+                    "n_votes": rng.randrange(2, 5),
+                    # paced BELOW the garbage/stale floods: replays are
+                    # counted (win_events) but not judged bad unless the
+                    # replay breaker is armed, so a replay firehose would
+                    # dilute the fleet's bad-rate under the breaker line
+                    # and the composed adversary would hide behind its
+                    # own noise
+                    "interval": round(rng.uniform(0.05, 0.1), 4),
+                }
+            )
+    return {
+        "level": level,
+        "ghost_txs": [g.hex() for g in ghosts],
+        "drivers": drivers,
+    }
+
+
+def _weather_schedule(tile: TileSpec) -> dict:
+    # the LinkShaper owns per-link domain separation below this seed
+    # (sha256 over seed|src|dst inside netem/shaper.py) — the axis only
+    # has to hand it a level-scoped root
+    prof = PROFILES[tile.weather]
+    return {
+        "profile": tile.weather,
+        "shaper_seed": axis_seed(tile.seed, "weather", tile.weather),
+        "p50_budget_ms": prof.p50_budget_ms,
+        "p99_budget_ms": prof.p99_budget_ms,
+    }
+
+
+def _overload_schedule(tile: TileSpec) -> dict:
+    shape = _OVERLOAD_SHAPE[tile.overload]
+    sched: dict = {"level": tile.overload, **shape}
+    if shape["threads"]:
+        rng = axis_rng(tile.seed, "overload", tile.overload)
+        sched["intervals"] = [
+            round(rng.uniform(0.01, 0.05), 4) for _ in range(shape["threads"])
+        ]
+        sched["tag"] = rng.randrange(1 << 20)
+    return sched
+
+
+def _stake_schedule(tile: TileSpec, n_validators: int) -> dict:
+    level = tile.stake
+    rng = axis_rng(tile.seed, "stake", level)
+    kind = "churning" if level == "churning" else level
+    powers = stake_distribution(
+        kind, n_validators, seed=rng.randrange(1 << 30), base=10
+    )
+    sched: dict = {"level": level, "kind": kind, "powers": powers}
+    # the adversary must never be quorum-critical: it takes the smallest
+    # stake, so disarming + quarantining it still leaves honest stake
+    # clear of 2n/3 (whale tiles put the whale on the honest side)
+    sched["adversary_index"] = powers.index(min(powers))
+    if level == "churning":
+        sched["budget_scale"] = _STAKE_BUDGET_SCALE["churning"]
+        # live churn: seed-deterministic ``val:`` re-weights (kvstore ->
+        # EndBlock -> H+2 engine restage), strictly-unique powers so the
+        # mempool dedup cache can never silently no-op an event
+        # never re-weight the (potential) adversary slot: a churn event
+        # boosting a disarmed validator could make it quorum-critical
+        # mid-tile, turning a stake statement into a liveness failure
+        candidates = [
+            i for i in range(n_validators) if i != sched["adversary_index"]
+        ]
+        events = []
+        for k in range(3):
+            events.append(
+                {
+                    "at_frac": round((k + 1) / 4 + rng.uniform(-0.05, 0.05), 3),
+                    "validator": rng.choice(candidates),
+                    "power": 20 + 3 * k + rng.randrange(3),
+                }
+            )
+        sched["churn"] = events
+    return sched

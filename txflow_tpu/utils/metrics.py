@@ -319,6 +319,30 @@ class NetMetrics:
             self.shaped_corrupted.set(total.get("corrupted", 0))
 
 
+class ScenarioMetrics:
+    """Scenario-grid observability (scenario/ subsystem).
+
+    A node driven by a grid tile publishes which tile and how far along
+    the walk — so an operator watching /metrics mid-soak can correlate a
+    latency spike with "tile 7 of 12, flood + flapping" without parsing
+    runner logs. The tile's string identity (axis levels) lives in the
+    /health "scenario" section; gauges carry only the numeric shape."""
+
+    def __init__(self, registry: "Registry | None" = None):
+        r = registry or GLOBAL
+        self.active = r.gauge("scenario", "active", "1 while a scenario tile drives this node")
+        self.tile_index = r.gauge("scenario", "tile_index", "zero-based index of the running tile (-1 when idle)")
+        self.tiles_total = r.gauge("scenario", "tiles_total", "tile count of the running grid walk")
+        self.tile_started_unix = r.gauge("scenario", "tile_started_unix", "wall-clock start of the running tile (unix seconds)")
+
+    def refresh_from(self, info: dict) -> None:
+        """Republish a registry scenario-section dict (possibly empty)."""
+        self.active.set(1.0 if info.get("active") else 0.0)
+        self.tile_index.set(float(info.get("tile_index", -1)))
+        self.tiles_total.set(float(info.get("tiles_total", 0)))
+        self.tile_started_unix.set(float(info.get("started_unix", 0.0)))
+
+
 class AdmissionMetrics:
     """Front-door admission metrics (admission/ subsystem).
 
