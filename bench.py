@@ -54,6 +54,25 @@ _MESH_DEVICES = int(_cli_or_env("--mesh-devices", "BENCH_MESH_DEVICES", "0") or 
 _HOST_PREP_WORKERS = int(
     _cli_or_env("--host-prep-workers", "BENCH_HOST_PREP_WORKERS", "0") or 0
 )
+# --host-prep-backend {thread,process} (BENCH_HOST_PREP_BACKEND): run the
+# host-prep pool as worker THREADS (historical default, GIL-shared) or
+# worker PROCESSES over shared memory (engine.hostprep.ProcHostPrepPool —
+# sidesteps the GIL for the sign-bytes/compact prep inner loops; falls
+# back to threads when process spawn fails). --staging-ring N
+# (BENCH_STAGING_RING): depth of the device readback ring (2 = double
+# buffering, <=1 = historical synchronous readback). --wide-buckets
+# (BENCH_WIDE_BUCKETS=1): let the coalescer drain the verifier ladder's
+# rungs above EngineConfig.max_batch, gated by the adaptive linger
+# controller's latency verdict.
+_HOST_PREP_BACKEND = (
+    _cli_or_env("--host-prep-backend", "BENCH_HOST_PREP_BACKEND", "thread")
+    or "thread"
+)
+_STAGING_RING = int(_cli_or_env("--staging-ring", "BENCH_STAGING_RING", "2") or 2)
+_WIDE_BUCKETS = (
+    "--wide-buckets" in sys.argv
+    or os.environ.get("BENCH_WIDE_BUCKETS", "0") == "1"
+)
 if _MESH_DEVICES > 1:
     # the CPU platform exposes ONE device unless told otherwise, and the
     # flag is read when jax initializes its backends — so it must be in
@@ -475,6 +494,7 @@ def run_bench(platform: str) -> dict:
         shared_verifier = DeviceVoteVerifier(
             val_set, buckets=(bucket, 4 * bucket), shared_cache=share_cache,
             mesh=mesh, host_prep_workers=_HOST_PREP_WORKERS,
+            host_prep_backend=_HOST_PREP_BACKEND, staging_ring=_STAGING_RING,
         )
         device_verifier = shared_verifier  # pre-mux handle for prep stats
         t0 = time.time()
@@ -613,6 +633,9 @@ def run_bench(platform: str) -> dict:
     # host-prep pool
     cfg.engine.mesh_devices = _MESH_DEVICES
     cfg.engine.host_prep_workers = _HOST_PREP_WORKERS
+    cfg.engine.host_prep_backend = _HOST_PREP_BACKEND
+    cfg.engine.staging_ring = _STAGING_RING
+    cfg.engine.wide_buckets = _WIDE_BUCKETS
 
     # BASELINE config 5: BENCH_CONSENSUS=1 runs the block-path ticker
     # DURING the vote flood (blocks carry the fast-path commits as Vtxs).
@@ -965,6 +988,17 @@ def run_bench(platform: str) -> dict:
         else 0
     )
     result["host_prep_workers"] = _HOST_PREP_WORKERS
+    # live backend, per node (a failed process spawn falls back to
+    # threads — the result records what actually ran, so bank entries
+    # from process- and thread-backend runs are comparable by label)
+    backends = {
+        s.get("host_prep_backend") for s in pipe_stats
+        if s.get("host_prep_backend")
+    }
+    result["host_prep_backend"] = (
+        sorted(backends)[0] if len(backends) == 1
+        else (sorted(backends) or None)
+    )
     host_prep = {
         "sign_s": round(sum(s.get("prep_sign_s", 0.0) for s in pipe_stats), 4),
         "pool_wait_s": round(
@@ -977,7 +1011,41 @@ def run_bench(platform: str) -> dict:
         host_prep["compact_pool_wait_s"] = round(
             ps.get("compact_pool_wait_s", 0.0), 4
         )
+        pool = getattr(device_verifier, "_host_pool", None)
+        pool_stats = pool.stats() if pool is not None else {}
+        if pool_stats.get("backend") == "process":
+            # shared-memory traffic of the process backend: segment
+            # bytes shipped per prep call (engine.hostprep _run_typed)
+            host_prep["shm_calls"] = pool_stats.get("shm_calls", 0)
+            host_prep["shm_bytes_total"] = pool_stats.get(
+                "shm_bytes_total", 0
+            )
+            host_prep["proc_wait_s"] = round(
+                pool_stats.get("proc_wait_s", 0.0), 4
+            )
     result["host_prep"] = host_prep
+    # double-buffered readback: ring depth + the hidden-overlap ledger
+    # (parallel.staging; readback seconds that ran under the engine's
+    # next-batch prep instead of on the critical path)
+    result["staging_ring"] = _STAGING_RING
+    ring_stats = [s.get("staging") for s in pipe_stats if s.get("staging")]
+    if device_verifier is not None and not ring_stats:
+        dv_ring = device_verifier.staging_stats()
+        if dv_ring is not None:
+            ring_stats = [dv_ring]
+    if ring_stats:
+        # engines share the verifier's ring: the snapshots are the same
+        # counters, take the freshest rather than summing duplicates
+        ring = max(ring_stats, key=lambda r: r.get("slots_total", 0))
+        result["staging"] = {
+            "depth": ring.get("depth"),
+            "slots_total": ring.get("slots_total", 0),
+            "readback_s": round(ring.get("readback_s", 0.0), 4),
+            "hidden_s": round(ring.get("hidden_s", 0.0), 4),
+            "overlap_frac": round(
+                ring.get("hidden_s", 0.0) / ring["readback_s"], 4
+            ) if ring.get("readback_s") else 0.0,
+        }
     coalesce = [s.get("coalesce") or {} for s in pipe_stats]
     result["coalesced_batches"] = sum(c.get("full_batches", 0) for c in coalesce)
     result["linger_flushes"] = sum(c.get("linger_flushes", 0) for c in coalesce)
@@ -1050,6 +1118,12 @@ def _bank_tpu_result(result: dict) -> None:
             result,
             measured_at_unix=round(time.time(), 1),
             contaminated=bool(result.get("compile_in_run")),
+            # backend label makes process- and thread-backend runs
+            # comparable bank entries: same supersede contract (clean
+            # overwrites clean regardless of backend — the bank tracks
+            # the freshest clean measurement, and the label says which
+            # host-prep posture produced it)
+            host_prep_backend=result.get("host_prep_backend") or "thread",
         )
         existing = _load_banked_tpu()
         if (
@@ -1067,7 +1141,11 @@ def _bank_tpu_result(result: dict) -> None:
 def _load_banked_tpu() -> dict | None:
     try:
         with open(_TPU_LATEST) as f:
-            return json.loads(f.read())
+            entry = json.loads(f.read())
+        # legacy entries predate the backend label: they all measured
+        # the thread backend, stamp it so comparisons are uniform
+        entry.setdefault("host_prep_backend", "thread")
+        return entry
     except (OSError, ValueError):
         return None
 

@@ -95,6 +95,14 @@ def main() -> None:
     if "--host-prep-workers" in sys.argv:
         workers = int(sys.argv[sys.argv.index("--host-prep-workers") + 1])
     cfg.engine.host_prep_workers = workers
+    # --host-prep-backend {thread,process}: worker threads (GIL-shared)
+    # vs worker processes over shared memory (engine.hostprep.Proc-
+    # HostPrepPool); the per-node hostprep[...] lines print which one
+    # actually ran (process spawn failure falls back to threads)
+    backend = os.environ.get("BENCH_HOST_PREP_BACKEND", "thread") or "thread"
+    if "--host-prep-backend" in sys.argv:
+        backend = sys.argv[sys.argv.index("--host-prep-backend") + 1]
+    cfg.engine.host_prep_backend = backend
     cfg.engine.mesh_devices = _MESH
 
     net = LocalNet(
@@ -186,10 +194,27 @@ def main() -> None:
                 f"cold={co['cold_fallback_votes']}]"
             )
         if "prep_sign_s" in s:
+            # backend is the LIVE one (process spawn failure falls back
+            # to threads); pool_wait under the process backend is shm
+            # shard wait (engine.hostprep proc_wait_s feeds it)
             line += (
                 f" hostprep[workers={s.get('host_prep_workers', 0)} "
+                f"backend={s.get('host_prep_backend') or 'none'} "
                 f"sign={s['prep_sign_s']:.3f}s "
                 f"pool_wait={s['prep_pool_wait_s']:.3f}s]"
+            )
+        ring = s.get("staging") or {}
+        if ring.get("slots_total"):
+            # double-buffered readback: hidden = D2H seconds that ran
+            # under the engine's next-batch prep; frac = hidden share
+            # of all readback (1.0 = every transfer fully overlapped)
+            rb = ring.get("readback_s", 0.0)
+            frac = (ring.get("hidden_s", 0.0) / rb) if rb else 0.0
+            line += (
+                f" staging[depth={ring['depth']} "
+                f"slots={ring['slots_total']} "
+                f"hidden={ring.get('hidden_s', 0.0):.3f}s "
+                f"overlap_frac={frac:.2f}]"
             )
         la = s.get("lanes") or {}
         if la.get("enabled"):

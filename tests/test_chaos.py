@@ -320,6 +320,12 @@ def test_crash_drill_restart_replays_exactly_once(tmp_path):
         fresh = b"drill-fresh=v"
         drill.submit(fresh)
         assert drill.wait_committed([fresh])
+        # wait_committed sees the persisted certificate, which lands
+        # ahead of the async committer's app delivery — give the
+        # delivery a bounded window before asserting exactly-once
+        deadline = time.monotonic() + 10.0
+        while app2.delivered[fresh] == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
         assert app2.delivered[fresh] == 1
     finally:
         drill.stop()

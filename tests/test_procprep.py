@@ -1,0 +1,264 @@
+"""Process-backed host prep (engine.hostprep.ProcHostPrepPool).
+
+The process backend is a pure parallelization of the existing host-prep
+row functions — every output must be byte-identical to the serial numpy
+path and to the thread-pool path, because all three run the SAME row
+core (prep_proc.prep_rows_cat / sign_rows). Covered here:
+
+- randomized byte-parity of process-pool compact prep vs serial vs
+  thread pool, over adversarial rows (corrupt sigs, wrong-length and
+  empty sigs, adversarial all-zero 64-byte sigs, non-minimal S >= L,
+  out-of-range validator indices) at partial-shard sizes;
+- sign-bytes parity vs canonical_sign_bytes, including the hostile
+  oversize-field decline (returns None, caller falls back);
+- mid-run restage: a second epoch (different validator set) through the
+  SAME pool stays byte-identical;
+- spawn-failure fallback: make_host_pool degrades to the thread backend;
+- shutdown hygiene: close() joins workers and unlinks every shm segment
+  (no /dev/shm leaks), and the atexit sweep is idempotent;
+- engine-level: a process-backend engine's commit certificates are
+  byte-identical to the scalar try_add_vote golden path.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from test_pipeline import (
+    _wait_quiescent,
+    make_engine as make_threaded_engine,
+    make_pvs,
+    sign_vote,
+)
+from test_verifier import make_batch, make_valset
+from txflow_tpu import prep_proc
+from txflow_tpu.crypto import ed25519 as host_ed
+from txflow_tpu.engine.hostprep import (
+    HostPrepPool,
+    ProcHostPrepPool,
+    close_all_pools,
+    make_host_pool,
+)
+from txflow_tpu.ops import ed25519_batch
+from txflow_tpu.types.tx_vote import canonical_sign_bytes
+
+COMPACT_FIELDS = ("s_nibbles", "h_nibbles", "val_idx", "r_y", "r_sign", "pre_ok")
+
+
+def _shm_names() -> set:
+    """Shared-memory DATA segments (the unlink contract's subject).
+    ``sem.mp-*`` entries are multiprocessing queue semaphores — freed
+    when the queue objects are garbage-collected, not by pool close."""
+    try:
+        return {n for n in os.listdir("/dev/shm") if not n.startswith("sem.")}
+    except OSError:
+        return set()
+
+
+@pytest.fixture(scope="module")
+def proc_pool():
+    """One spawned pool for the parity tests (spawn costs ~1.5 s on the
+    1-core CI box; the tests exercise distinct calls, not distinct
+    pools)."""
+    pool = make_host_pool(3, backend="process", name="hostprep-proctest")
+    if pool.backend != "process":
+        pytest.skip("process pool unavailable on this platform")
+    yield pool
+    pool.close()
+
+
+def _adversarial_batch(vals, seeds, n):
+    """Adversarial rows beyond make_batch's corrupt modes: the sig-shape
+    attacks only the cat-form representation could get wrong."""
+    msgs, sigs, vidx, _ = make_batch(
+        vals, seeds, n_txs=-(-n // len(seeds)),
+        corrupt=("ok", "flip", "ok", "wrongkey", "badidx"),
+    )
+    msgs, sigs, vidx = msgs[:n], list(sigs[:n]), np.array(vidx[:n])
+    L = prep_proc.L
+    for i in range(0, n, 13):
+        sigs[i] = b""  # empty: length-invalid
+    for i in range(1, n, 17):
+        sigs[i] = sigs[i][:40]  # truncated: length-invalid
+    for i in range(2, n, 19):
+        sigs[i] = bytes(64)  # adversarial all-zero: length-VALID, S=0
+    for i in range(3, n, 23):
+        # non-minimal scalar: S >= L must fail ScMinimal
+        s_bad = (L + 5).to_bytes(32, "little")
+        sigs[i] = sigs[i][:32] + s_bad
+    for i in range(4, n, 29):
+        vidx[i] = -2  # negative validator index
+    return msgs, sigs, vidx
+
+
+@pytest.mark.parametrize("n", [601, 293])  # partial, non-worker-divisible
+def test_process_pool_compact_parity(proc_pool, n):
+    """Process-pool prepare_compact == serial == thread pool, field for
+    field, over adversarial rows at partial-shard sizes."""
+    vals, seeds = make_valset(4)
+    msgs, sigs, vidx = _adversarial_batch(vals, seeds, n)
+    epoch = ed25519_batch.EpochTables([v.pub_key for v in vals])
+
+    serial = ed25519_batch.prepare_compact(msgs, sigs, vidx, epoch)
+    shm_before = proc_pool.stats()["shm_calls"]
+    proc = ed25519_batch.prepare_compact(msgs, sigs, vidx, epoch, pool=proc_pool)
+    assert proc_pool.stats()["shm_calls"] == shm_before + 1, (
+        "process pool never took the shared-memory path"
+    )
+    thread_pool = HostPrepPool(3, name="hostprep-proctest-t")
+    try:
+        threaded = ed25519_batch.prepare_compact(
+            msgs, sigs, vidx, epoch, pool=thread_pool
+        )
+    finally:
+        thread_pool.close()
+    for field in COMPACT_FIELDS:
+        np.testing.assert_array_equal(
+            getattr(serial, field), getattr(proc, field), err_msg=field
+        )
+        np.testing.assert_array_equal(
+            getattr(serial, field), getattr(threaded, field), err_msg=field
+        )
+
+
+def test_process_pool_sign_bytes_parity(proc_pool):
+    """sign_bytes_shm == canonical_sign_bytes row for row, and hostile
+    oversize fields make the shm path decline (None) instead of
+    truncating."""
+    heights = [1, 2, 2**40, 7, 0]
+    hashes = [hashlib.sha256(b"t%d" % i).hexdigest().upper() for i in range(5)]
+    ts = [1700000000_000000000 + i for i in range(5)]
+    out = proc_pool.sign_bytes_shm(heights, hashes, ts, "proc-chain")
+    assert out is not None
+    rows, wait_s = out
+    assert wait_s >= 0.0
+    expect = [
+        canonical_sign_bytes("proc-chain", h, x, t)
+        for h, x, t in zip(heights, hashes, ts)
+    ]
+    assert rows == expect
+
+    # hostile: a tx_hash past the shm stride bound declines the fast path
+    big = proc_pool.sign_bytes_shm([1], ["A" * 2048], [1], "proc-chain")
+    assert big is None
+
+
+def test_process_pool_mid_run_restage(proc_pool):
+    """A second epoch (different validator set) through the SAME pool:
+    the per-call shm protocol holds no per-epoch state to go stale."""
+    for tag, n_vals in (("a", 4), ("b", 7)):
+        vals, seeds = make_valset(n_vals)
+        msgs, sigs, vidx = _adversarial_batch(vals, seeds, 300 + n_vals)
+        epoch = ed25519_batch.EpochTables([v.pub_key for v in vals])
+        serial = ed25519_batch.prepare_compact(msgs, sigs, vidx, epoch)
+        proc = ed25519_batch.prepare_compact(
+            msgs, sigs, vidx, epoch, pool=proc_pool
+        )
+        for field in COMPACT_FIELDS:
+            np.testing.assert_array_equal(
+                getattr(serial, field), getattr(proc, field),
+                err_msg=f"{tag}:{field}",
+            )
+
+
+def test_spawn_failure_falls_back_to_threads():
+    """An unspawnable process pool degrades to the thread backend —
+    callers keep a working pool, never an exception."""
+    pool = make_host_pool(
+        3, backend="process", name="hostprep-bogus", mp_context="bogus"
+    )
+    try:
+        assert pool.backend == "thread"
+        assert isinstance(pool, HostPrepPool)
+        assert pool.workers == 3
+    finally:
+        pool.close()
+
+
+def test_close_releases_workers_and_shm():
+    """close() joins every worker process and unlinks every tracked shm
+    segment; the atexit sweep (close_all_pools) is an idempotent no-op
+    afterwards."""
+    before = _shm_names()
+    pool = ProcHostPrepPool(3, name="hostprep-closetest")
+    vals, seeds = make_valset(4)
+    msgs, sigs, vidx = _adversarial_batch(vals, seeds, 300)
+    epoch = ed25519_batch.EpochTables([v.pub_key for v in vals])
+    out = pool.prepare_compact_shm(msgs, sigs, vidx, epoch)
+    assert out is not None
+    procs = list(pool._procs)
+    assert procs, "no worker processes spawned"
+    pool.close()
+    for p in procs:
+        assert not p.is_alive(), "worker process leaked past close()"
+    leaked = _shm_names() - before
+    assert not leaked, f"shm segments leaked: {leaked}"
+    close_all_pools()  # idempotent with everything already closed
+
+
+def test_engine_process_backend_certificates_match_golden():
+    """An engine on the process host-prep backend commits byte-identical
+    certificates to the scalar try_add_vote golden path (same stream,
+    ~15% corrupted signatures)."""
+    import random
+
+    rng = random.Random(31)
+    pvs, vals = make_pvs(4)
+    txs = [b"proc%d=%d" % (i, i) for i in range(80)]  # 80*4=320 >= pool gate
+    stream = []
+    for tx in txs:
+        for vi in range(4):
+            vote = sign_vote(pvs[vi], tx)
+            if rng.random() < 0.15:
+                vote.signature = bytes(64)
+            stream.append(vote)
+    rng.shuffle(stream)
+
+    flow_s, mem_s, _, store_s, app_s = make_threaded_engine(
+        vals, use_device=False
+    )
+    for tx in txs:
+        mem_s.check_tx(tx)
+    for v in stream:
+        flow_s.try_add_vote(v.copy())
+
+    flow_p, mem_p, pool_p, store_p, app_p = make_threaded_engine(
+        vals, use_device=False, host_prep_workers=3,
+        host_prep_backend="process", max_batch=1024,
+    )
+    for tx in txs:
+        mem_p.check_tx(tx)
+    for v in stream:  # queue before start: one big pooled drain
+        try:
+            pool_p.check_tx(v)
+        except Exception:
+            pass  # cache dup (zeroed sigs share a vote key) — scalar saw it
+    flow_p.start()
+    try:
+        assert _wait_quiescent(flow_p, pool_p), "process engine never drained"
+        stats = flow_p.pipeline_stats()
+        pool_stats = flow_p._host_pool.stats()
+    finally:
+        flow_p.stop()
+
+    if stats["host_prep_backend"] == "process":
+        assert pool_stats["shm_calls"] > 0, (
+            "process backend ran but never took the shm sign-bytes path"
+        )
+    assert app_p.tx_count == app_s.tx_count
+    assert app_p.state == app_s.state
+    assert app_p.digest == app_s.digest  # commit ORDER identical
+    committed = 0
+    for tx in txs:
+        tx_hash = hashlib.sha256(tx).hexdigest().upper()
+        cs = store_s.load_tx_commit(tx_hash)
+        cp = store_p.load_tx_commit(tx_hash)
+        assert (cs is None) == (cp is None)
+        if cs is not None:
+            committed += 1
+            assert [
+                (c.validator_address, c.signature) for c in cs.commits
+            ] == [(c.validator_address, c.signature) for c in cp.commits]
+    assert committed > 0, "stream never formed a quorum — test is vacuous"

@@ -27,10 +27,14 @@ r5 sim result (4096 txs, serialized device):
 
 Usage: JAX_PLATFORMS=cpu python tools/sim_device.py [--fixed-ms 8]
        [--per-slot-us 27.6] [--txs 4096] [--mesh-devices 4] [--psum-ms 0.5]
-       [--host-workers 4] [--host-us-per-vote 41]
+       [--host-workers 4] [--host-us-per-vote 41] [--gil-frac 0.55]
+       [--shm-ms 1.5]
 With --mesh-devices N the per-slot bill divides across N chips (plus one
 psum per step); the run ends with a host-vs-device crossover table showing
-the mesh size past which HOST prep binds and worker scaling takes over.
+the mesh size past which HOST prep binds and worker scaling takes over,
+then a thread-vs-process host-pool backend crossover (at which worker
+count the process backend's GIL escape beats its shared-memory toll —
+the --host-prep-backend advisor for bench.py).
 """
 
 import argparse
@@ -262,6 +266,51 @@ def print_crossover(fixed_s, psum_s, per_slot_s, host_us_per_vote,
               f"scale host workers (--host-workers), not devices")
 
 
+def backend_model(bucket: int, host_us_per_vote: float, workers: int,
+                  gil_frac: float, shm_ms: float) -> dict:
+    """Per-batch host-prep cost under each pool backend (seconds).
+
+    Thread backend: Amdahl with a GIL-serialized fraction — the
+    sign-bytes assembly and Python-level glue hold the GIL, so only
+    ``1 - gil_frac`` of the per-vote work parallelizes across W threads
+    (hashlib/numpy release the GIL; the bytes plumbing does not).
+    Process backend: near-linear scaling (workers hold separate GILs)
+    plus a fixed per-batch shared-memory toll — segment create/pack/
+    attach/ack (engine.hostprep._run_typed), which threads never pay.
+    The crossover: processes win once the GIL-serialized slice of a
+    batch exceeds the shm toll."""
+    w = max(1, workers)
+    serial_s = bucket * host_us_per_vote / 1e6
+    thread_s = serial_s * (gil_frac + (1.0 - gil_frac) / w)
+    proc_s = serial_s / w + (shm_ms / 1e3 if w > 1 else 0.0)
+    return {"thread_s": thread_s, "process_s": proc_s}
+
+
+def print_backend_crossover(host_us_per_vote: float, gil_frac: float,
+                            shm_ms: float, bucket: int = 4096) -> None:
+    """Thread-vs-process host-pool crossover table: at which worker
+    count (if any) does the process backend's GIL escape beat its
+    shared-memory toll? Advises --host-prep-backend for bench.py runs
+    on multi-core postures; on a 1-core box the table shows why the
+    thread backend stays the right default."""
+    print(f"host-pool backend crossover at bucket {bucket} "
+          f"(gil_frac={gil_frac:.2f}, shm toll {shm_ms:.1f} ms/batch):")
+    crossed = None
+    for w in (1, 2, 4, 8, 16):
+        m = backend_model(bucket, host_us_per_vote, w, gil_frac, shm_ms)
+        best = "process" if m["process_s"] < m["thread_s"] else "thread"
+        if crossed is None and best == "process":
+            crossed = w
+        print(f"  workers={w:2d}  thread {m['thread_s']*1e3:7.1f} ms  "
+              f"process {m['process_s']*1e3:7.1f} ms  best={best}")
+    if crossed is None:
+        print("  thread-bound through 16 workers: the shm toll outweighs "
+              "the GIL escape at this batch size — keep backend=thread")
+    else:
+        print(f"  crossover at workers={crossed}: run "
+              f"--host-prep-backend process at or past this width")
+
+
 def lane_latency_model(arrival_vps: float, linger_s: float, fixed_s: float,
                        per_slot_s: float, mesh: int = 1,
                        bucket_cap: int = 512) -> dict:
@@ -327,6 +376,13 @@ def main():
     ap.add_argument("--host-us-per-vote", type=float, default=41.0,
                     help="host prep cost per vote (sign-bytes + compact prep; "
                          "~41 us/vote gives the ROADMAP's 18.4k host-bound)")
+    ap.add_argument("--gil-frac", type=float, default=0.55,
+                    help="GIL-serialized fraction of per-vote host prep for "
+                         "the thread-backend model (bytes glue holds the "
+                         "GIL; hashlib/numpy release it)")
+    ap.add_argument("--shm-ms", type=float, default=1.5,
+                    help="fixed per-batch shared-memory toll of the process "
+                         "backend (segment create/pack/attach/ack)")
     ap.add_argument("--lane-sweep", action="store_true",
                     help="print the priority-lane linger sweep (predicted "
                          "p50 vs lane linger at --lane-arrival-vps)")
@@ -348,6 +404,8 @@ def main():
     print_crossover(args.fixed_ms / 1e3, args.psum_ms / 1e3,
                     args.per_slot_us / 1e6, args.host_us_per_vote,
                     args.host_workers)
+    print_backend_crossover(args.host_us_per_vote, args.gil_frac,
+                            args.shm_ms)
 
 
 if __name__ == "__main__":

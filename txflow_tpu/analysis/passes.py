@@ -423,8 +423,16 @@ _HOT_FUNCS = {
         # lane-split + speculative-commit helpers (ISSUE 12): all run
         # inside the fill/route stages of the pipelined loop
         "_prio_pending", "_bulk_pending", "_bulk_quantum",
-        "_steer_lingers",
+        "_steer_lingers", "_sign_bytes_proc",
     },
+    # the staging ring's whole point is that the ONLY np.asarray lives
+    # in its dedicated readback thread (StageSlot._run): the caller-
+    # facing enter/exit paths must never force the transfer themselves,
+    # or the ring silently degrades to the synchronous readback it
+    # replaced. (StagingRing.submit's bounded semaphore wait is
+    # backpressure by contract — this pin is about device syncs, not
+    # blocking in general.)
+    "txflow_tpu/parallel/staging.py": {"submit", "result"},
 }
 
 _HOT_ATTRS = {
@@ -528,6 +536,13 @@ _TRACE_SCOPE = (
     # timeline (maybe_observe takes `now` from the caller, but any future
     # internal timestamp must come through the same seam)
     "txflow_tpu/engine/adaptive.py",
+    # worker-process prep core: shard busy_s rides the done-queue acks
+    # into pool stats that sit next to traced engine spans — same seam
+    # so a pinned-clock test keeps both on one timeline
+    "txflow_tpu/prep_proc.py",
+    # staging-ring overlap ledger (hidden_s/readback_s) is compared
+    # against traced device spans in report.py — same seam required
+    "txflow_tpu/parallel/staging.py",
     "txflow_tpu/trace/",
     "txflow_tpu/admission/controller.py",
     "txflow_tpu/pool/",

@@ -144,6 +144,15 @@ class AdaptiveLingerController:
         self.observations = 0
         self.last_p50_ms: float | None = None
         self._next_due: float | None = None
+        # wide-rung verdict (EngineConfig.wide_buckets): may the bulk
+        # coalescer dispatch buckets ABOVE the classic drain cap? A
+        # 65536-row drain amortizes per-call overhead but holds the
+        # pipeline for one long kernel; under latency pressure that
+        # hold IS the SLO breach. Hysteresis: breach (p50 > budget)
+        # revokes, deep headroom (p50 < budget/4) restores — the band
+        # between holds the last verdict so the gate doesn't flap at
+        # the budget line.
+        self.wide_ok = True
 
     def maybe_observe(self, digest_fn, now: float) -> bool:
         """Cadence gate + digest pull; returns True when the lingers
@@ -163,7 +172,11 @@ class AdaptiveLingerController:
     def observe(self, p50_ms: float) -> bool:
         self.observations += 1
         self.last_p50_ms = float(p50_ms)
-        old = (self.prio_linger, self.bulk_linger)
+        old = (self.prio_linger, self.bulk_linger, self.wide_ok)
+        if p50_ms > self.slo_budget_ms:
+            self.wide_ok = False
+        elif p50_ms < 0.25 * self.slo_budget_ms:
+            self.wide_ok = True
         if p50_ms > self.slo_budget_ms:
             # priority shrinks harder: it carries the SLO; bulk keeps
             # more of its coalescing so throughput degrades gracefully
@@ -180,7 +193,7 @@ class AdaptiveLingerController:
             self.bulk_linger = min(
                 self.bulk_target, self.bulk_linger * self.relax
             )
-        changed = (self.prio_linger, self.bulk_linger) != old
+        changed = (self.prio_linger, self.bulk_linger, self.wide_ok) != old
         if changed:
             self.adjustments += 1
         return changed
@@ -192,6 +205,7 @@ class AdaptiveLingerController:
             "bulk_linger_ms": round(self.bulk_linger * 1e3, 4),
             "adjustments": self.adjustments,
             "observations": self.observations,
+            "wide_ok": self.wide_ok,
             "last_p50_ms": (
                 round(self.last_p50_ms, 3)
                 if self.last_p50_ms is not None else None

@@ -129,12 +129,13 @@ class _BatchCoalescer:
     __slots__ = (
         "targets", "linger", "full_batches", "linger_flushes",
         "_deadline", "_idle", "_clock", "_metrics", "_tracer", "_hold_t0",
-        "_span_name",
+        "_span_name", "wide_from", "wide_ok", "wide_full_batches",
     )
 
     def __init__(self, buckets, cap: int, min_batch: int, linger: float,
                  metrics=None, clock=monotonic, tracer=None,
-                 multiple: int = 1, span_name: str = SPAN_LINGER):
+                 multiple: int = 1, span_name: str = SPAN_LINGER,
+                 wide_from: int | None = None):
         # mesh divisibility: a sharded verifier pads every dispatch up to
         # a multiple of its shard count anyway (verifier.bucket_size), so
         # round the full-bucket targets here and drain exactly what the
@@ -159,6 +160,16 @@ class _BatchCoalescer:
         # per-lane trace family (linger / linger_prio / linger_bulk):
         # report.py attributes the hold to the lane that paid it
         self._span_name = span_name
+        # wide-rung gate (EngineConfig.wide_buckets): rungs ABOVE
+        # wide_from are eligible only while wide_ok holds — the adaptive
+        # linger controller clears it (set_wide) when batch latency
+        # breaches budget, since one 65536-row dispatch that blows the
+        # deadline costs more than the per-call overhead it saved. A
+        # coalescer built without wide_from (wide_from=None) has no
+        # wide rungs, so the gate is inert.
+        self.wide_from = None if wide_from is None else int(wide_from)
+        self.wide_ok = True
+        self.wide_full_batches = 0
 
     def decide(self, pending: int) -> int:
         """Votes to dispatch NOW: a full canonical bucket, the whole
@@ -170,6 +181,12 @@ class _BatchCoalescer:
         full = 0
         for b in self.targets:
             if pending >= b:
+                if (
+                    self.wide_from is not None
+                    and b > self.wide_from
+                    and not self.wide_ok
+                ):
+                    break  # wide rungs gated off: stop at the classic cap
                 full = b
             else:
                 break
@@ -177,6 +194,8 @@ class _BatchCoalescer:
             self._deadline = None
             self._idle = False
             self.full_batches += 1
+            if self.wide_from is not None and full > self.wide_from:
+                self.wide_full_batches += 1
             if self._metrics is not None:
                 self._metrics.coalesce_full_batches.add(1)
             return full
@@ -197,6 +216,11 @@ class _BatchCoalescer:
                 self._tracer.span("", self._span_name, self._hold_t0, now)
             return pending
         return 0
+
+    def set_wide(self, ok: bool) -> None:
+        """Gate the wide rungs (called from the engine thread by
+        ``_steer_lingers`` with the adaptive controller's verdict)."""
+        self.wide_ok = bool(ok)
 
     def note_idle(self) -> None:
         """The pool wait timed out with votes pending and nothing new
@@ -279,6 +303,10 @@ class TxFlow:
                         host_prep_workers=int(
                             self.config.host_prep_workers or 0
                         ),
+                        host_prep_backend=str(
+                            self.config.host_prep_backend or "thread"
+                        ),
+                        staging_ring=int(self.config.staging_ring),
                     )
                 )
             except ValueError:  # total power >= 2^30: int32 tally overflow
@@ -292,6 +320,17 @@ class TxFlow:
             self.config.max_batch,
             getattr(self.verifier, "max_batch", self.config.max_batch),
         )
+        # wide coalescer rungs (EngineConfig.wide_buckets): let drains
+        # reach the verifier ladder's rungs ABOVE config.max_batch —
+        # they are canonical compiled shapes already (DEFAULT_BUCKETS
+        # tops out past the default cap precisely for this), so wider
+        # steps amortize per-call overhead with zero new compiles. The
+        # classic cap survives as the coalescer's wide_from gate line.
+        self._classic_drain_cap = self._drain_cap
+        if self.config.wide_buckets:
+            buckets = self._verifier_buckets()
+            if buckets:
+                self._drain_cap = max(self._drain_cap, max(buckets))
         self.vote_sets: dict[str, TxVoteSet] = {}  # in-flight only
         self._committed = make_lru(1 << 16)  # recently committed tx hashes
         # ingest-log cursor: each pool entry is visited by step() exactly
@@ -468,6 +507,13 @@ class TxFlow:
                     # verifier's rounded shapes (verifier.bucket_size)
                     multiple=self._verifier_shards(),
                     span_name=SPAN_LINGER_BULK,
+                    # rungs past the classic cap are latency-gated
+                    # (wide_buckets); None when the cap wasn't widened
+                    wide_from=(
+                        self._classic_drain_cap
+                        if self._drain_cap > self._classic_drain_cap
+                        else None
+                    ),
                 )
         if self.config.lane_split and self._prio_lane is None:
             # priority verify lane (ISSUE 12): small shard-divisible
@@ -507,13 +553,18 @@ class TxFlow:
                 # must not spawn N * workers threads (ensure_host_pool
                 # is first-sizer-wins)
                 self._host_pool = dev.ensure_host_pool(
-                    int(self.config.host_prep_workers)
+                    int(self.config.host_prep_workers),
+                    backend=str(self.config.host_prep_backend or "thread"),
                 )
             else:
-                from .hostprep import HostPrepPool
+                from .hostprep import make_host_pool
 
-                self._host_pool = HostPrepPool(
-                    int(self.config.host_prep_workers), name="hostprep-engine"
+                # make_host_pool falls back to the thread backend when
+                # process spawn fails (HostPoolSpawnError swallowed)
+                self._host_pool = make_host_pool(
+                    int(self.config.host_prep_workers),
+                    backend=str(self.config.host_prep_backend or "thread"),
+                    name="hostprep-engine",
                 )
                 self._own_host_pool = True
         if self.config.adaptive_depth and self._depth_ctrl is None:
@@ -665,6 +716,10 @@ class TxFlow:
                 self._prio_lane.linger = ctrl.prio_linger
             if self._coalescer is not None:
                 self._coalescer.linger = ctrl.bulk_linger
+                # latency verdict also gates the wide bucket rungs: a
+                # budget breach shuts the >classic-cap drains off until
+                # batch p50 recovers (adaptive.wide_ok hysteresis)
+                self._coalescer.set_wide(getattr(ctrl, "wide_ok", True))
             self.metrics.adaptive_linger_changes.add(1)
 
     def _run_serial(self) -> None:
@@ -979,6 +1034,44 @@ class TxFlow:
             )
         return decided + prep.dropped
 
+    def _sign_bytes_proc(self, votes, pool) -> "list[bytes] | None":
+        """Sign bytes for a drain batch via the PROCESS host pool.
+
+        Mirrors types.tx_vote.sign_bytes_many exactly — cache scan
+        inline (hits are free and never cross a process boundary),
+        misses encoded by worker processes over shared memory
+        (hostprep.ProcHostPrepPool.sign_bytes_shm), caches primed with
+        the returned bytes. Returns None when the shm path declines
+        (hostile field bounds, broken pool) so the caller can fall back
+        to the thread/serial encode — same bytes on every path (parity
+        pinned by tests/test_procprep.py)."""
+        out: list[bytes | None] = [None] * len(votes)
+        miss: list[int] = []
+        for i, v in enumerate(votes):
+            c = v._sb_cache
+            if c is not None and c[0] == self.chain_id:
+                out[i] = c[1]
+            else:
+                miss.append(i)
+        if miss:
+            res = pool.sign_bytes_shm(
+                [votes[i].height for i in miss],
+                [votes[i].tx_hash for i in miss],
+                [votes[i].timestamp_ns for i in miss],
+                self.chain_id,
+            )
+            if res is None:
+                return None
+            rows, wait_s = res
+            self._pipe_prep_pool_wait_s += wait_s
+            for j, i in enumerate(miss):
+                out[i] = rows[j]
+                if votes[i].signature is not None:  # immutable once signed
+                    object.__setattr__(
+                        votes[i], "_sb_cache", (self.chain_id, rows[j])
+                    )
+        return out  # type: ignore[return-value]
+
     def _prep_batch(
         self, limit: int | None = None, lane: str | None = None
     ) -> "_StepPrep | None":
@@ -1137,6 +1230,35 @@ class TxFlow:
 
         pool = self._host_pool
         t_sign = monotonic()
+        if (
+            pool is not None
+            and getattr(pool, "backend", "thread") == "process"
+            and getattr(pool, "healthy", False)
+            and len(votes) >= _POOL_MIN_VOTES
+        ):
+            # process backend: sign-bytes encode runs in worker PROCESSES
+            # over shared memory (no GIL contention with the engine
+            # thread). None return = hostile field bounds or a broken
+            # pool — fall through to the thread/serial paths below.
+            msgs = self._sign_bytes_proc(votes, pool)
+            if msgs is not None:
+                prep.msgs = msgs
+                prep.sigs = [v.signature or b"" for v in votes]
+                prep.val_idx = np.array(
+                    [addr_to_idx.get(v.validator_address, -1) for v in votes],
+                    dtype=np.int64,
+                )
+                self._pipe_prep_sign_s += monotonic() - t_sign
+                end = monotonic()
+                dur = end - t0
+                self._pipe_prep_s += dur
+                self._pipe_active_s += dur
+                self.metrics.pipeline_prep_seconds.add(dur)
+                if prep.trace_txs:
+                    tx0 = prep.trace_txs[0]
+                    self.tracer.span(tx0, SPAN_LOCK_WAIT, t0, lk_acq)
+                    self.tracer.span(tx0, SPAN_PREP, t0, end)
+                return prep
         if pool is not None and pool.workers > 1 and len(votes) >= _POOL_MIN_VOTES:
 
             def _assemble(lo: int, hi: int):
@@ -1463,6 +1585,13 @@ class TxFlow:
             "host_prep_workers": (
                 self._host_pool.workers if self._host_pool is not None else 0
             ),
+            # live backend, not the configured one: a failed process
+            # spawn falls back to threads and this reports the truth
+            "host_prep_backend": (
+                getattr(self._host_pool, "backend", "thread")
+                if self._host_pool is not None
+                else None
+            ),
             "mesh_devices": self._verifier_shards(),
         }
         co = self._coalescer
@@ -1471,6 +1600,13 @@ class TxFlow:
             "full_batches": co.full_batches if co is not None else 0,
             "linger_flushes": co.linger_flushes if co is not None else 0,
             "cold_fallback_votes": self._cold_fallback_votes,
+            # wide-rung ladder (wide_buckets): gate line, live verdict,
+            # and how many drains actually rode the wide rungs
+            "wide_from": co.wide_from if co is not None else None,
+            "wide_ok": co.wide_ok if co is not None else None,
+            "wide_full_batches": (
+                co.wide_full_batches if co is not None else 0
+            ),
         }
         pl = self._prio_lane
         stats["lanes"] = {
@@ -1505,6 +1641,14 @@ class TxFlow:
             self.metrics.warmup_warm_shapes.set(warm)
         if ctrl is not None:
             stats["adaptive_depth"] = ctrl.stats()
+        from .shapes import _unwrap_device
+
+        dev = _unwrap_device(self.verifier)
+        if dev is not None:
+            ring = getattr(dev, "staging_stats", None)
+            ring_stats = ring() if ring is not None else None
+            if ring_stats is not None:
+                stats["staging"] = ring_stats
         return stats
 
     # ---- scalar parity API (reference TryAddVote :169-188) ----

@@ -235,6 +235,17 @@ def prepare_compact(
     n = len(msgs)
     if pool is None or pool.workers <= 1 or n < _POOL_MIN_ROWS:
         return fn(msgs, sigs, val_idx, epoch)
+    if getattr(pool, "backend", "thread") == "process":
+        # typed shared-memory path: workers run the same row core
+        # (prep_proc.prep_rows_cat[_native]) over contiguous shards of
+        # the cat-form batch, writing straight into the output segment.
+        # Falls back to the thread shards below if the pool has degraded.
+        out = pool.prepare_compact_shm(msgs, sigs, np.asarray(val_idx), epoch)
+        if out is not None:
+            s_nib, h_nib, vidx, r_y, r_sign, pre_ok, wait_s = out
+            return CompactBatch(
+                s_nib, h_nib, vidx, r_y, r_sign, pre_ok, pool_wait_s=wait_s
+            )
     vi = np.asarray(val_idx)
 
     def _shard(lo: int, hi: int) -> CompactBatch:
@@ -354,59 +365,20 @@ def _prepare_compact_np(
     unavailable (no C compiler in the container).
 
     Bit-identical to ``_prepare_compact_py`` (pinned by
-    tests/test_mesh_engine.py): signature splitting, the ScMinimal
-    big-endian compare, and R extraction are all array ops; only the
-    SHA-512 + mod-L reduction stays per row (hashlib has no batch API),
-    and only over rows that survive the vectorized pre-checks. The
-    per-row Python loop this replaces spent most of its time on row
-    slicing and per-row frombuffer, not on the hash."""
-    n = len(msgs)
-    n_vals = len(epoch.pub_keys)
-    vi = np.asarray(val_idx, dtype=np.int64)
-    clipped = np.clip(vi, 0, max(n_vals - 1, 0))
-    idx_ok = (vi >= 0) & (vi < n_vals)
-    sig_ok = np.fromiter((len(s) == 64 for s in sigs), bool, n)
-    sig_cat = (
-        b"".join(sigs)
-        if bool(sig_ok.all())
-        else b"".join(s if len(s) == 64 else _ZERO64 for s in sigs)
+    tests/test_mesh_engine.py). The row math lives in
+    ``prep_proc.prep_rows_cat`` — the SAME function process-pool workers
+    run over shared-memory shards — so there is exactly one numpy
+    implementation and thread/process/serial assembly parity holds by
+    construction, not by duplicated code."""
+    from ..prep_proc import cat_msgs, cat_sigs, prep_rows_cat
+
+    msg_cat, offs = cat_msgs(msgs)
+    sig_arr, sig_ok = cat_sigs(sigs)
+    s_nib, h_nib, vidx, r_y, r_sign, ok = prep_rows_cat(
+        msg_cat, offs, sig_arr, sig_ok,
+        np.asarray(val_idx, dtype=np.int64), epoch.pub_arr, epoch.key_ok,
     )
-    sig_all = np.frombuffer(sig_cat, np.uint8).reshape(n, 64)
-    ok = idx_ok & sig_ok & (epoch.key_ok[clipped] if n_vals else False)
-    # ScMinimal (S < L), vectorized: compare big-endian byte rows
-    # lexicographically — sign of the first differing byte decides
-    s_be = sig_all[:, :31:-1]  # bytes 63..32: S, most-significant first
-    l_be = np.frombuffer(host_ed.L.to_bytes(32, "big"), np.uint8)
-    diff = s_be.astype(np.int16) - l_be.astype(np.int16)
-    nz = diff != 0
-    first = np.where(nz.any(axis=1), nz.argmax(axis=1), 31)
-    ok &= np.take_along_axis(diff, first[:, None], 1)[:, 0] < 0
-    s_le = np.where(ok[:, None], sig_all[:, 32:], 0).astype(np.uint8)
-    h_le = np.zeros((n, 32), np.uint8)
-    sha512 = hashlib.sha512
-    L = host_ed.L
-    for i in np.flatnonzero(ok):
-        sig = sigs[i]
-        h = (
-            int.from_bytes(
-                sha512(sig[:32] + epoch.pub_keys[vi[i]] + msgs[i]).digest(),
-                "little",
-            )
-            % L
-        )
-        h_le[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
-    # failed rows stay all-zero, matching the per-row oracle
-    r_y = np.where(ok[:, None], sig_all[:, :32], 0).astype(np.uint8)
-    r_sign = (r_y[:, 31] >> 7).astype(np.uint8)
-    r_y[:, 31] &= 0x7F
-    return CompactBatch(
-        nibbles_from_le_bytes(s_le),
-        nibbles_from_le_bytes(h_le),
-        clipped.astype(np.int32),
-        r_y,
-        r_sign,
-        ok,
-    )
+    return CompactBatch(s_nib, h_nib, vidx, r_y, r_sign, ok)
 
 
 def verify_kernel_gather(

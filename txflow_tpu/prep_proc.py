@@ -1,0 +1,380 @@
+"""Process-side host-prep core: the worker half of ``ProcHostPrepPool``.
+
+The thread-backed ``engine.hostprep.HostPrepPool`` parallelizes host prep
+only as far as the GIL allows: the native ``_prep.so`` and numpy release
+it, but the pure-Python slices (per-row SHA-512 driving loop, sign-bytes
+encoding when the C codec is absent) serialize. This module is the seam
+past that wall: worker *processes* execute the two typed prep tasks —
+compact ed25519 prep and canonical sign-bytes assembly — writing their
+contiguous row shards **directly into ``multiprocessing.shared_memory``
+buffers**, so the parent assembles the batch with zero IPC copies beyond
+the one input marshal.
+
+Design constraints, in order:
+
+- **Import-light by construction.** A spawned worker imports THIS module
+  only; the package ``__init__`` is docstring-only and everything heavy
+  (jax, the engine) stays out of the chain. Task-specific deps
+  (``types.tx_vote`` for sign bytes, ``native`` for the C fast paths)
+  load lazily inside the task body, so a ``fork`` worker reuses the
+  parent's modules and a ``spawn`` worker pays numpy + stdlib up front
+  and the rest on first use.
+- **Bit-identical contiguous shards.** Each task computes rows
+  ``[lo, hi)`` of the SAME deterministic row function the serial paths
+  use (``prep_rows_cat`` is also the engine-side numpy implementation —
+  ``ops.ed25519_batch._prepare_compact_np`` delegates here), and writes
+  them at row offset ``lo`` of the shared output arrays. Assembly order
+  therefore never affects bytes; parity with the serial and thread-pool
+  preps is pinned by tests/test_procprep.py.
+- **Crash containment.** A worker that dies mid-shard only costs its
+  shard: the parent notices the missing ack and recomputes the rows
+  inline (engine.hostprep.ProcHostPrepPool), then stops routing typed
+  work to processes.
+
+Shared-memory protocol (one segment pair per ``map`` call): the parent
+packs every input array back-to-back into one segment and preallocates
+one output segment, then enqueues per-shard descriptors carrying the
+segment NAMES plus an (offset, dtype, shape) table. Workers attach by
+name (attachments cached per worker), build numpy views, run the task,
+ack, and the parent copies the outputs out before unlinking both
+segments — no segment outlives the call that created it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from .utils.clock import monotonic
+
+# ed25519 group order (crypto.ed25519.L restated here so workers never
+# import beyond numpy + stdlib on the compact path; value pinned against
+# the golden model by tests/test_procprep.py)
+L = 2**252 + 27742317777372353535851937790883648493
+
+_L_BE = np.frombuffer(L.to_bytes(32, "big"), np.uint8)
+
+ZERO64 = bytes(64)
+
+
+def nibbles_from_le_bytes(b: np.ndarray) -> np.ndarray:
+    """[B, 32] little-endian uint8 scalars -> [B, 64] MSB-first nibbles."""
+    rev = b[:, ::-1]
+    out = np.empty((b.shape[0], 64), np.uint8)
+    out[:, 0::2] = rev >> 4
+    out[:, 1::2] = rev & 15
+    return out
+
+
+def cat_msgs(msgs: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
+    """Concatenated-bytes form of a message list: (msg_cat u8, offs i64)."""
+    n = len(msgs)
+    offs = np.zeros(n + 1, np.int64)
+    np.cumsum(np.fromiter((len(m) for m in msgs), np.int64, n), out=offs[1:])
+    msg_cat = np.frombuffer(b"".join(msgs), np.uint8) if n else np.zeros(0, np.uint8)
+    return msg_cat, offs
+
+
+def cat_sigs(sigs: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
+    """([n, 64] u8 signature rows, [n] bool length-ok mask).
+
+    Wrong-length signatures become zero rows, so the mask MUST travel with
+    the rows: a zero row alone is indistinguishable from an adversarial
+    genuinely-all-zero 64-byte signature, which the serial prep treats as
+    length-OK (S=0 passes ScMinimal and the hash runs over R=0) — byte
+    parity of ``pre_ok``/``h_nibbles`` depends on keeping the two apart.
+    """
+    n = len(sigs)
+    len_ok = np.fromiter((len(s) == 64 for s in sigs), bool, n)
+    sig_cat = (
+        b"".join(sigs)
+        if bool(len_ok.all())
+        else b"".join(s if len(s) == 64 else ZERO64 for s in sigs)
+    )
+    arr = (
+        np.frombuffer(sig_cat, np.uint8).reshape(n, 64)
+        if n
+        else np.zeros((0, 64), np.uint8)
+    )
+    return arr, len_ok
+
+
+def prep_rows_cat(
+    msg_cat: np.ndarray,
+    offs: np.ndarray,
+    sig_arr: np.ndarray,
+    sig_ok: np.ndarray,
+    vi: np.ndarray,
+    pub_arr: np.ndarray,
+    key_ok: np.ndarray,
+    lo: int = 0,
+    hi: int | None = None,
+) -> tuple[np.ndarray, ...]:
+    """Compact ed25519 prep over rows ``[lo, hi)`` of the cat-form batch.
+
+    THE numpy implementation: ``ops.ed25519_batch._prepare_compact_np``
+    (serial and thread-pool shards) delegates its whole-batch case here,
+    and process workers call it per shard — one row function, so every
+    backend's assembled batch is bit-identical by construction. Returns
+    ``(s_nib u8[m,64], h_nib u8[m,64], vidx i32[m], r_y u8[m,32],
+    r_sign u8[m], pre_ok bool[m])`` for the ``m = hi - lo`` rows.
+
+    Row semantics (pinned against ``_prepare_compact_py``): a row fails
+    pre-check — and stays all-zero — on unknown validator index, bad
+    signature length (zero row in ``sig_arr``; the packer zeroed it),
+    off-curve/malformed key (``key_ok`` False) or non-minimal S; the
+    SHA-512 + mod-L reduction runs only over surviving rows.
+    """
+    n = int(sig_arr.shape[0])
+    if hi is None:
+        hi = n
+    lo = max(0, int(lo))
+    hi = min(n, int(hi))
+    m = hi - lo
+    n_vals = int(pub_arr.shape[0])
+    vi = np.asarray(vi, dtype=np.int64)[lo:hi]
+    sig_all = np.ascontiguousarray(sig_arr[lo:hi])
+    clipped = np.clip(vi, 0, max(n_vals - 1, 0))
+    ok = (vi >= 0) & (vi < n_vals) & np.asarray(sig_ok, bool)[lo:hi]
+    if n_vals:
+        ok &= np.asarray(key_ok, bool)[clipped]
+    else:
+        ok &= False
+    # ScMinimal (S < L), vectorized: compare big-endian byte rows
+    # lexicographically — sign of the first differing byte decides
+    s_be = sig_all[:, :31:-1]  # bytes 63..32: S, most-significant first
+    diff = s_be.astype(np.int16) - _L_BE.astype(np.int16)
+    nz = diff != 0
+    first = np.where(nz.any(axis=1), nz.argmax(axis=1), 31)
+    ok &= np.take_along_axis(diff, first[:, None], 1)[:, 0] < 0
+    s_le = np.where(ok[:, None], sig_all[:, 32:], 0).astype(np.uint8)
+    h_le = np.zeros((m, 32), np.uint8)
+    sha512 = hashlib.sha512
+    offs = np.asarray(offs, dtype=np.int64)
+    mc = msg_cat
+    for i in np.flatnonzero(ok):
+        gi = lo + i
+        sig_r = sig_all[i, :32].tobytes()
+        pub = pub_arr[clipped[i]].tobytes()
+        msg = mc[offs[gi] : offs[gi + 1]].tobytes()
+        h = int.from_bytes(sha512(sig_r + pub + msg).digest(), "little") % L
+        h_le[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
+    # failed rows stay all-zero, matching the per-row oracle
+    r_y = np.where(ok[:, None], sig_all[:, :32], 0).astype(np.uint8)
+    r_sign = (r_y[:, 31] >> 7).astype(np.uint8)
+    r_y[:, 31] &= 0x7F
+    return (
+        nibbles_from_le_bytes(s_le),
+        nibbles_from_le_bytes(h_le),
+        clipped.astype(np.int32),
+        r_y,
+        r_sign,
+        ok,
+    )
+
+
+def prep_rows_cat_native(
+    msg_cat,
+    offs,
+    sig_arr,
+    sig_ok,
+    vi,
+    pub_arr,
+    key_ok,
+    lo: int = 0,
+    hi: int | None = None,
+):
+    """Native-C variant of ``prep_rows_cat`` (same returns, same bytes —
+    native/prep.c parity is pinned by tests/test_native_prep.py); returns
+    None when the compiled module is unavailable in this process."""
+    try:
+        from . import native
+    except Exception:
+        return None
+    if not native.available():
+        return None
+    n = int(sig_arr.shape[0])
+    if hi is None:
+        hi = n
+    lo, hi = max(0, int(lo)), min(n, int(hi))
+    n_vals = int(pub_arr.shape[0])
+    vi = np.asarray(vi, dtype=np.int64)[lo:hi]
+    clipped = np.clip(vi, 0, max(n_vals - 1, 0))
+    idx_ok = (vi >= 0) & (vi < n_vals) & np.asarray(sig_ok, bool)[lo:hi]
+    if n_vals:
+        ok_in = (idx_ok & np.asarray(key_ok, bool)[clipped]).astype(np.uint8)
+        pubs = np.ascontiguousarray(pub_arr[clipped])
+    else:
+        ok_in = np.zeros(hi - lo, np.uint8)
+        pubs = np.zeros((hi - lo, 32), np.uint8)
+    offs = np.asarray(offs, dtype=np.int64)
+    base = offs[lo]
+    sub_offs = np.ascontiguousarray(offs[lo : hi + 1] - base)
+    sub_cat = np.ascontiguousarray(msg_cat[base : offs[hi]])
+    sig_sub = np.ascontiguousarray(sig_arr[lo:hi])
+    out = native.prep_batch(sub_cat, sub_offs, sig_sub, pubs, ok_in)
+    if out is None:
+        return None
+    s_le, h_le, pre_ok = out
+    r_y = np.where(pre_ok[:, None], sig_sub[:, :32], 0).astype(np.uint8)
+    r_sign = (r_y[:, 31] >> 7).astype(np.uint8)
+    r_y[:, 31] &= 0x7F
+    return (
+        nibbles_from_le_bytes(s_le),
+        nibbles_from_le_bytes(h_le),
+        clipped.astype(np.int32),
+        r_y,
+        r_sign,
+        pre_ok.astype(bool),
+    )
+
+
+def sign_rows(
+    heights: np.ndarray,
+    ts_ns: np.ndarray,
+    hash_cat: np.ndarray,
+    hash_offs: np.ndarray,
+    chain_id: str,
+    lo: int,
+    hi: int,
+    out: np.ndarray,
+    out_len: np.ndarray,
+) -> None:
+    """Canonical sign bytes for rows ``[lo, hi)`` into fixed-stride rows
+    of ``out`` (lengths in ``out_len``) — the process-task twin of
+    ``types.tx_vote.sign_bytes_many``'s miss path. Uses the native batch
+    codec when this process has it, else the per-row Python encoder;
+    both produce the same bytes (tests/test_native_prep.py)."""
+    from .types.tx_vote import canonical_sign_bytes  # lazy: spawn-light top
+
+    hs = [int(heights[i]) for i in range(lo, hi)]
+    ts = [int(ts_ns[i]) for i in range(lo, hi)]
+    hashes = [
+        hash_cat[hash_offs[i] : hash_offs[i + 1]].tobytes().decode("utf-8", "surrogatepass")
+        for i in range(lo, hi)
+    ]
+    batch = None
+    try:
+        from . import native
+
+        batch = native.sign_bytes_batch(hs, hashes, ts, chain_id)
+    except Exception:
+        batch = None
+    for j in range(hi - lo):
+        sb = batch[j] if batch is not None else None
+        if sb is None:
+            sb = canonical_sign_bytes(chain_id, hs[j], hashes[j], ts[j])
+        row = np.frombuffer(sb, np.uint8)
+        out[lo + j, : len(row)] = row
+        out_len[lo + j] = len(row)
+
+
+def sign_bytes_stride(max_hash_len: int, chain_id: str) -> int:
+    """Upper bound on one canonical sign-bytes row: fixed fields + varint
+    headroom over the variable hash/chain-id parts."""
+    return 80 + int(max_hash_len) + len(chain_id.encode())
+
+
+# ---------------------------------------------------------------------------
+# Shared-memory layout + worker loop
+
+
+def pack_layout(arrays: dict[str, np.ndarray]) -> tuple[list[tuple], int]:
+    """(name, dtype-str, shape, offset) table + total bytes for packing
+    ``arrays`` back-to-back (8-byte aligned) into one shm segment."""
+    layout = []
+    off = 0
+    for name, a in arrays.items():
+        a = np.ascontiguousarray(a)
+        layout.append((name, a.dtype.str, a.shape, off))
+        off += int(a.nbytes + 7) & ~7
+    return layout, max(off, 1)
+
+
+def write_arrays(buf, layout: list[tuple], arrays: dict[str, np.ndarray]) -> None:
+    for name, dt, shape, off in layout:
+        a = np.ascontiguousarray(arrays[name])
+        dst = np.ndarray(shape, dtype=np.dtype(dt), buffer=buf, offset=off)
+        dst[...] = a
+
+
+def views(buf, layout: list[tuple]) -> dict[str, np.ndarray]:
+    return {
+        name: np.ndarray(shape, dtype=np.dtype(dt), buffer=buf, offset=off)
+        for name, dt, shape, off in layout
+    }
+
+
+def run_task(task: str, ins: dict, outs: dict, lo: int, hi: int) -> None:
+    """Execute one typed shard against input/output array views.
+
+    ``compact``: ed25519 compact prep rows (native when available, numpy
+    otherwise — identical bytes either way). ``signbytes``: canonical
+    sign-bytes rows. Both write ONLY rows [lo, hi) of the outputs."""
+    if task == "compact":
+        args = (
+            ins["msg_cat"], ins["offs"], ins["sig_arr"], ins["sig_ok"],
+            ins["vi"], ins["pub_arr"], ins["key_ok"],
+        )
+        rows = prep_rows_cat_native(*args, lo=lo, hi=hi)
+        if rows is None:
+            rows = prep_rows_cat(*args, lo=lo, hi=hi)
+        s_nib, h_nib, vidx, r_y, r_sign, pre_ok = rows
+        outs["s_nib"][lo:hi] = s_nib
+        outs["h_nib"][lo:hi] = h_nib
+        outs["vidx"][lo:hi] = vidx
+        outs["r_y"][lo:hi] = r_y
+        outs["r_sign"][lo:hi] = r_sign
+        outs["pre_ok"][lo:hi] = pre_ok.astype(np.uint8)
+    elif task == "signbytes":
+        sign_rows(
+            ins["heights"], ins["ts_ns"], ins["hash_cat"], ins["hash_offs"],
+            ins["chain_id"], lo, hi, outs["rows"], outs["lens"],
+        )
+    else:  # unknown task: the parent's version skew guard catches this
+        raise ValueError(f"unknown prep task {task!r}")
+
+
+def worker_main(task_q, done_q) -> None:
+    """Worker-process loop: attach shm by name, run shards, ack.
+
+    Descriptors: ``("task", task, shard_id, in_name, in_layout, out_name,
+    out_layout, lo, hi, extra)`` — ``extra`` carries small non-array
+    inputs (chain_id). ``None`` is the shutdown sentinel. Acks:
+    ``("ready", pid)`` once at startup, then ``(shard_id, err_str|None,
+    busy_s)`` per shard. Segment attachments are cached per call name and
+    dropped after each shard (segments never outlive their call)."""
+    import os
+    from multiprocessing import shared_memory
+
+    done_q.put(("ready", os.getpid()))
+    while True:
+        item = task_q.get()
+        if item is None:
+            return
+        (_tag, task, shard_id, in_name, in_layout, out_name, out_layout,
+         lo, hi, extra) = item
+        t0 = monotonic()
+        err = None
+        seg_in = seg_out = None
+        try:
+            seg_in = shared_memory.SharedMemory(name=in_name)
+            seg_out = shared_memory.SharedMemory(name=out_name)
+            ins = views(seg_in.buf, in_layout)
+            if extra:
+                ins = {**ins, **extra}
+            outs = views(seg_out.buf, out_layout)
+            run_task(task, ins, outs, lo, hi)
+            del ins, outs
+        except BaseException as exc:  # ack the failure; parent recomputes
+            err = f"{type(exc).__name__}: {exc}"
+        finally:
+            # drop numpy views BEFORE closing (close invalidates the buf)
+            for seg in (seg_in, seg_out):
+                if seg is not None:
+                    try:
+                        seg.close()
+                    except BufferError:
+                        pass  # a view survived; the unlink still reclaims
+        done_q.put((shard_id, err, monotonic() - t0))

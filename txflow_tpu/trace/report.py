@@ -63,6 +63,19 @@ def critical_path(pipeline_stats: dict, trace_digest: dict | None = None) -> dic
     if pool_wait > 0.0:
         out["prep_pool_wait_s"] = round(min(pool_wait, host), 4)
         out["prep_serial_s"] = round(host - min(pool_wait, host), 4)
+    # Staging-ring split of the device bucket: device_s keeps its
+    # historical meaning (wall seconds blocked collecting tickets =
+    # dispatch + the readback slice the ring could NOT hide), aliased
+    # as device_dispatch_s; readback_overlap_hidden_s is the D2H
+    # transfer seconds the ring ran UNDER the engine's next-batch prep
+    # (parallel.staging hidden_s) — attribution context like
+    # spec_saved_s: time removed from the critical path, not busy time.
+    ring = stats.get("staging") or {}
+    if ring.get("slots_total"):
+        out["device_dispatch_s"] = out["device_s"]
+        out["readback_overlap_hidden_s"] = round(
+            ring.get("hidden_s", 0.0), 4
+        )
     if busy > 0:
         out["fractions"] = {
             k.removesuffix("_s"): round(v / busy, 4) for k, v in parts.items()
@@ -87,7 +100,8 @@ def merge_critical_paths(per_node: list[dict]) -> dict:
     keys = ("host_s", "device_s", "lock_wait_s", "linger_s")
     total = {k: round(sum(cp.get(k, 0.0) for cp in per_node), 4) for k in keys}
     for k in ("prep_serial_s", "prep_pool_wait_s", "linger_prio_s",
-              "linger_bulk_s", "spec_saved_s"):
+              "linger_bulk_s", "spec_saved_s", "device_dispatch_s",
+              "readback_overlap_hidden_s"):
         if any(k in cp for cp in per_node):
             total[k] = round(sum(cp.get(k, 0.0) for cp in per_node), 4)
     if any("spec_commits" in cp for cp in per_node):
@@ -129,6 +143,11 @@ def format_line(cp: dict) -> str:
         line += (
             f" host[prep_serial={cp.get('prep_serial_s', 0.0):.3f}s"
             f" prep_pool_wait={cp['prep_pool_wait_s']:.3f}s]"
+        )
+    if cp.get("readback_overlap_hidden_s") is not None:
+        line += (
+            f" device[dispatch={cp.get('device_dispatch_s', 0.0):.3f}s"
+            f" readback_hidden={cp['readback_overlap_hidden_s']:.3f}s]"
         )
     if cp.get("spec_saved_s") is not None:
         line += (

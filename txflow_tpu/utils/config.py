@@ -179,6 +179,28 @@ class EngineConfig:
     # across workers is real parallelism even on GIL builds. 0 = serial
     # prep on the engine thread (reference behavior).
     host_prep_workers: int = 0
+    # host-prep backend (engine.hostprep.make_host_pool): "thread" keeps
+    # the caller-steals thread pool; "process" runs worker PROCESSES that
+    # assemble sign-bytes/compact arrays into shared-memory segments —
+    # past the GIL entirely, for the pure-Python prep slices threads
+    # can't parallelize. Degrades to "thread" automatically when workers
+    # can't spawn; assembled batches are byte-identical either way.
+    host_prep_backend: str = "thread"
+    # double-buffered device readback (parallel.staging.StagingRing):
+    # depth of the readback ring on the device verifier. At 2, batch N's
+    # device_put + dispatch overlaps batch N-1's packed readback (the
+    # ring thread pulls results eagerly); <=1 restores the synchronous
+    # readback at collect. Certificates are byte-identical either way —
+    # the ring only moves WHERE np.asarray runs.
+    staging_ring: int = 2
+    # wide coalescer rungs (engine.txflow._BatchCoalescer): let the bulk
+    # lane target bucket-ladder rungs ABOVE max_batch (the verifier's
+    # ladder already compiles them) so per-call overhead amortizes over
+    # bigger steps at sustained load. Gated by the AdaptiveLingerController
+    # when adaptive_linger is on — wide rungs disarm the moment the SLO
+    # bank runs hot, so latency never pays for the amortization. Off by
+    # default: the banked bench baselines were tuned at the classic cap.
+    wide_buckets: bool = False
     # deadline-aware verify lanes (engine.txflow): split the drain into
     # a PRIORITY lane — the pool's priority ingest log (admission fee
     # lanes), dispatched in small short-linger batches AHEAD of the bulk

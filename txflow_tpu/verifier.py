@@ -268,6 +268,35 @@ class ReadyTicket(VerifyTicket):
         return self._result
 
 
+class _RingHandle:
+    """A dispatched device array whose readback rides the staging ring.
+
+    Stands in for the raw device array inside tickets: the ring's side
+    thread is (or soon will be) pulling the bytes to host, and ``get()``
+    waits on that slot with overlap accounting instead of issuing the
+    transfer itself."""
+
+    __slots__ = ("_ring", "_slot")
+
+    def __init__(self, ring, slot):
+        self._ring = ring
+        self._slot = slot
+
+    def get(self) -> np.ndarray:
+        return self._ring.result(self._slot)
+
+
+def _force_readback(packed) -> np.ndarray:
+    """The ONE blocking device->host readback, ring-aware: staged handles
+    wait on their slot (transfer already in flight off-thread), raw
+    device arrays take the historical synchronous np.asarray. Same device
+    array, same host bytes either way — certificate parity is untouched
+    by WHERE the transfer runs."""
+    if isinstance(packed, _RingHandle):
+        return packed.get()
+    return np.asarray(packed)
+
+
 class _FusedDeviceTicket(VerifyTicket):
     """Dispatched fused kernel (no cache): readback + unpack at result()."""
 
@@ -288,7 +317,7 @@ class _FusedDeviceTicket(VerifyTicket):
         if self._done is not None:
             return self._done
         note_blocking("verifier.device-readback")
-        packed = np.asarray(self._packed)  # the ONE blocking readback
+        packed = _force_readback(self._packed)  # the ONE blocking readback
         self._packed = None
         rows = packed.reshape(self._n_shards, -1)
         bs = self._b // self._n_shards
@@ -345,7 +374,7 @@ class _CachedDeviceTicket(VerifyTicket):
         self._cache.heartbeat_many(self._miss_keys)
         note_blocking("verifier.device-readback")
         try:
-            packed = np.asarray(self._packed)  # blocking readback
+            packed = _force_readback(self._packed)  # blocking readback
         except BaseException:
             # claims must not outlive a failed readback (waiters would
             # stall until the TTL) — hand them to the next asker
@@ -609,6 +638,8 @@ class DeviceVoteVerifier:
         buckets=DEFAULT_BUCKETS,
         shared_cache: "VerifyCache | bool | None" = None,
         host_prep_workers: int = 0,
+        host_prep_backend: str = "thread",
+        staging_ring: int = 2,
     ):
         # cross-engine verify-result sharing (VerifyCache docstring):
         # True = own cache; an instance = share with other verifiers
@@ -682,14 +713,23 @@ class DeviceVoteVerifier:
         # (ensure_host_pool), so worker count doesn't multiply per node
         self._host_pool = None
         self.host_prep_workers = 0
+        self.host_prep_backend = "thread"
         self._stats_mtx = make_lock("verifier.DeviceVoteVerifier._stats_mtx")
         # host-prep stage seconds (prep_stats()): wall time inside
         # prepare_compact on the dispatch paths, and the slice of it spent
         # waiting on pool shards this thread didn't run itself
         self._compact_s = 0.0
         self._compact_pool_wait_s = 0.0
+        # double-buffered readback (parallel.staging.StagingRing): packed
+        # device results enter the ring at dispatch and a side thread
+        # pulls them to host eagerly, so batch N's device_put + dispatch
+        # overlaps batch N-1's readback. <=1 = the historical synchronous
+        # np.asarray at ticket.result(). Lazily built on first dispatch
+        # so verifiers constructed for restage tests cost nothing.
+        self.staging_depth = max(0, int(staging_ring))
+        self._staging = None
         if host_prep_workers:
-            self.ensure_host_pool(host_prep_workers)
+            self.ensure_host_pool(host_prep_workers, host_prep_backend)
         # validator capacity: the power-of-two sizes the existing 4/16/64
         # test and bench configs already compile for are their own pow2,
         # so padding is free there and gives odd-sized sets in-place
@@ -724,20 +764,26 @@ class DeviceVoteVerifier:
     def _powers_dev(self):
         return self._stage.powers_dev
 
-    def ensure_host_pool(self, workers: int):
+    def ensure_host_pool(self, workers: int, backend: str = "thread"):
         """Attach (or return) the shared host-prep pool, idempotently.
 
-        First caller with workers > 1 sizes it; later callers — the other
-        engines sharing this verifier — reuse it regardless of the count
-        they ask for, so a 4-node LocalNet over one shared verifier runs
-        ONE pool, not four. Returns the pool (None when serial)."""
+        First caller with workers > 1 sizes it — backend included; later
+        callers — the other engines sharing this verifier — reuse it
+        regardless of the count or backend they ask for, so a 4-node
+        LocalNet over one shared verifier runs ONE pool, not four.
+        ``backend="process"`` degrades to threads if workers can't spawn
+        (engine.hostprep.make_host_pool). Returns the pool (None when
+        serial)."""
         if workers and workers > 1 and self._host_pool is None:
             with self._stats_mtx:
                 if self._host_pool is None:
-                    from .engine.hostprep import HostPrepPool
+                    from .engine.hostprep import make_host_pool
 
-                    pool = HostPrepPool(workers, name="hostprep-verify")
+                    pool = make_host_pool(
+                        workers, backend=backend, name="hostprep-verify"
+                    )
                     self.host_prep_workers = pool.workers
+                    self.host_prep_backend = pool.backend
                     self._host_pool = pool
         return self._host_pool
 
@@ -754,6 +800,32 @@ class DeviceVoteVerifier:
             self._compact_pool_wait_s += batch.pool_wait_s
         return batch
 
+    def _stage_readback(self, packed):
+        """Enter a just-dispatched device array into the staging ring.
+
+        Lazily builds the ring on first dispatch (under ``_stats_mtx`` —
+        one ring per verifier, shared by every engine). Returns the
+        handle ``_force_readback`` understands: a ``_RingHandle`` when
+        staged, the raw device array when the ring is disabled
+        (``staging_ring <= 1``)."""
+        if self.staging_depth < 2:
+            return packed
+        ring = self._staging
+        if ring is None:
+            with self._stats_mtx:
+                ring = self._staging
+                if ring is None:
+                    from .parallel.staging import StagingRing
+
+                    ring = StagingRing(self.staging_depth, name="verify-staging")
+                    self._staging = ring
+        return _RingHandle(ring, ring.submit(packed))
+
+    def staging_stats(self) -> dict | None:
+        """Staging-ring counters (None until the first staged dispatch)."""
+        ring = self._staging
+        return None if ring is None else ring.stats()
+
     def prep_stats(self) -> dict:
         """Host-prep stage seconds across every engine sharing this
         verifier (bench result JSON + profile_host.py host-pool lines)."""
@@ -762,6 +834,7 @@ class DeviceVoteVerifier:
                 "compact_s": self._compact_s,
                 "compact_pool_wait_s": self._compact_pool_wait_s,
                 "host_prep_workers": self.host_prep_workers,
+                "host_prep_backend": self.host_prep_backend,
             }
         if self._host_pool is not None:
             out["pool"] = self._host_pool.stats()
@@ -967,12 +1040,14 @@ class DeviceVoteVerifier:
             s_nib, h_nib, vidx, r_y, r_sign, pre_ok, slot,
             st.tables_dev, st.powers_dev, prior, q,
         )
-        # ONE readback — deferred to ticket.result(); per-shard layout
+        # ONE readback — deferred to ticket.result() and (with a staging
+        # ring) already in flight on the ring thread; per-shard layout
         # [valid b/n | stake S | maj S] (tally.compact_step_packed);
         # stake/maj repeat the replicated global per shard — the ticket
         # takes shard 0's copy
         return _FusedDeviceTicket(
-            packed, n, n_slots, self._n_shards, b, b_slots, keep
+            self._stage_readback(packed), n, n_slots, self._n_shards, b,
+            b_slots, keep,
         )
 
     def _submit_cached(
@@ -1065,7 +1140,7 @@ class DeviceVoteVerifier:
         the cached submit path dispatches via _dispatch_verify_only and
         defers this readback to the ticket."""
         packed, b = self._dispatch_verify_only(msgs, sigs, val_idx)
-        rows = np.asarray(packed).reshape(self._n_shards, -1)
+        rows = _force_readback(packed).reshape(self._n_shards, -1)
         bs = b // self._n_shards
         return rows[:, :bs].reshape(-1).astype(bool)[: len(msgs)]
 
@@ -1147,7 +1222,7 @@ class DeviceVoteVerifier:
             # the dispatch (and any compile inside it) is behind us: stamp
             # the claims once more so the readback window starts fresh
             self.cache.heartbeat_many(claim_keys)
-        return packed, b
+        return self._stage_readback(packed), b
 
 
 class ResilientVoteVerifier:
