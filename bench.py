@@ -73,6 +73,17 @@ _WIDE_BUCKETS = (
     "--wide-buckets" in sys.argv
     or os.environ.get("BENCH_WIDE_BUCKETS", "0") == "1"
 )
+# --validators N (BENCH_VALIDATORS): validator-set size. --committee-size N
+# (BENCH_COMMITTEE_SIZE): per-epoch tx-vote committee sampling (committee/)
+# — only the deterministic stake-proportional sample signs, certificates
+# carry >2/3 of COMMITTEE stake, and verification is one batched device
+# call. 0 (default) = full-set seed behavior. The sublinear-certificate
+# acceptance config is --validators 256 --committee-size 32: cert votes,
+# cert bytes and votes gossiped per tx are then flat in validator count.
+_N_VALIDATORS = int(_cli_or_env("--validators", "BENCH_VALIDATORS", "4") or 4)
+_COMMITTEE_SIZE = int(
+    _cli_or_env("--committee-size", "BENCH_COMMITTEE_SIZE", "0") or 0
+)
 if _MESH_DEVICES > 1:
     # the CPU platform exposes ONE device unless told otherwise, and the
     # flag is read when jax initializes its backends — so it must be in
@@ -248,7 +259,7 @@ def run_latency_slo(platform: str) -> dict:
     from txflow_tpu.utils.config import test_config
     from txflow_tpu.utils.events import EventTx
 
-    n_vals = int(os.environ.get("BENCH_VALIDATORS", "4"))
+    n_vals = _N_VALIDATORS
     n_txs = int(os.environ.get("BENCH_SLO_TXS", "256"))
     prio_frac = float(os.environ.get("BENCH_SLO_PRIORITY_FRAC", "0.25"))
     pace_tps = float(os.environ.get("BENCH_SLO_PACE_TPS", "200"))
@@ -421,7 +432,7 @@ def run_bench(platform: str) -> dict:
     from txflow_tpu.types import TxVote
     from txflow_tpu.utils.events import EventTx
 
-    n_vals = int(os.environ.get("BENCH_VALIDATORS", "4"))
+    n_vals = _N_VALIDATORS
     # --stake-dist {uniform,whale,longtail} (or BENCH_STAKE_DIST): run the
     # same corpus under a non-uniform stake distribution (faults/stake.py).
     # Uniform powers never exercise the interesting quorum geometry — a
@@ -450,6 +461,46 @@ def run_bench(platform: str) -> dict:
     chunk = int(os.environ.get("BENCH_CHUNK", "512" if on_cpu else "2048"))
     warm_txs = min(64 if on_cpu else 1024, n_txs)
 
+    import hashlib as _h
+
+    from txflow_tpu.types.priv_validator import MockPV
+    from txflow_tpu.types.validator import Validator, ValidatorSet
+
+    priv_vals = [
+        MockPV(_h.sha256(b"localnet-val%d" % i).digest()) for i in range(n_vals)
+    ]
+    val_set = ValidatorSet(
+        [
+            Validator.from_pub_key(pv.get_pub_key(), p)
+            for pv, p in zip(priv_vals, stake_powers)
+        ]
+    )
+    # --committee-size N: sample the static (epoch-0) committee exactly as
+    # every node will (same chain_id, same sha256 seed domain), so the
+    # bench can pregenerate votes for COMMITTEE MEMBERS ONLY — that is
+    # the sublinear claim: votes gossiped per tx, certificate votes and
+    # verify cost all track committee size, not validator count
+    committee_set = None
+    signer_idx = list(range(n_vals))
+    epoch_config = None
+    if _COMMITTEE_SIZE > 0:
+        from txflow_tpu.committee import sample_committee
+        from txflow_tpu.epoch import EpochConfig
+
+        epoch_config = EpochConfig(committee_size=_COMMITTEE_SIZE)
+        committee_set = sample_committee(
+            val_set, "txflow-bench", 0, _COMMITTEE_SIZE,
+            min_size=epoch_config.committee_min_size,
+            min_stake_frac=epoch_config.committee_min_stake_frac,
+        )
+        members = {v.address for v in committee_set}
+        signer_idx = [
+            i for i, pv in enumerate(priv_vals) if pv.get_address() in members
+        ]
+    # the set the verifiers stage on: the committee IS the tally set in
+    # committee mode (its quorum_power() is the committee quorum)
+    engine_val_set = committee_set if committee_set is not None else val_set
+
     shared_verifier = None
     device_verifier = None
     warm_registry = None
@@ -457,21 +508,8 @@ def run_bench(platform: str) -> dict:
         # ONE verifier for all nodes (same validator set): shared device
         # epoch tables, and a single bucket so exactly one kernel shape
         # compiles (the persistent cache then makes reruns warm-start)
-        import hashlib as _h
-
-        from txflow_tpu.types.priv_validator import MockPV
-        from txflow_tpu.types.validator import Validator, ValidatorSet
         from txflow_tpu.verifier import DeviceVoteVerifier
 
-        priv_vals = [
-            MockPV(_h.sha256(b"localnet-val%d" % i).digest()) for i in range(n_vals)
-        ]
-        val_set = ValidatorSet(
-            [
-                Validator.from_pub_key(pv.get_pub_key(), p)
-                for pv, p in zip(priv_vals, stake_powers)
-            ]
-        )
         bucket = int(os.environ.get("BENCH_BUCKET", "4096"))
         # cross-engine verify-result cache (verifier.VerifyCache): the 4
         # co-located engines see the same gossiped votes; without it each
@@ -492,7 +530,7 @@ def run_bench(platform: str) -> dict:
                     file=sys.stderr,
                 )
         shared_verifier = DeviceVoteVerifier(
-            val_set, buckets=(bucket, 4 * bucket), shared_cache=share_cache,
+            engine_val_set, buckets=(bucket, 4 * bucket), shared_cache=share_cache,
             mesh=mesh, host_prep_workers=_HOST_PREP_WORKERS,
             host_prep_backend=_HOST_PREP_BACKEND, staging_ring=_STAGING_RING,
         )
@@ -554,26 +592,24 @@ def run_bench(platform: str) -> dict:
                 gather_wait=float(os.environ.get("BENCH_MUX_WAIT", "0.02")),
             )
             shared_verifier.start()
+    elif committee_set is not None:
+        # committee mode on the CPU fallback: ONE BatchCertVerifier
+        # staged on the committee, shared by all nodes — every engine
+        # verify batch is a single fused ed25519_batch dispatch (the
+        # verifier's batch_calls counter is stamped into the result as
+        # evidence). No verify cache: the cache-claim protocol is a
+        # per-signature loop and would defeat the one-call-per-batch
+        # claim this config exists to measure.
+        from txflow_tpu.committee import BatchCertVerifier
+
+        shared_verifier = BatchCertVerifier(engine_val_set)
     else:
         # CPU fallback: ONE scalar verifier with the cross-engine verify
         # cache shared by all nodes — host ed25519 is ~269 us/verify on
         # this class of core, and without the cache every vote pays it
         # once per node
-        import hashlib as _h
-
-        from txflow_tpu.types.priv_validator import MockPV
-        from txflow_tpu.types.validator import Validator, ValidatorSet
         from txflow_tpu.verifier import ScalarVoteVerifier
 
-        priv_vals = [
-            MockPV(_h.sha256(b"localnet-val%d" % i).digest()) for i in range(n_vals)
-        ]
-        val_set = ValidatorSet(
-            [
-                Validator.from_pub_key(pv.get_pub_key(), p)
-                for pv, p in zip(priv_vals, stake_powers)
-            ]
-        )
         if os.environ.get("BENCH_SHARE_CACHE", "1") == "1":
             shared_verifier = ScalarVoteVerifier(val_set, shared_cache=True)
 
@@ -693,6 +729,7 @@ def run_bench(platform: str) -> dict:
         index_txs=False,  # nothing queries /tx_search during the bench
         n_nodes=n_nodes,
         voting_powers=stake_powers,
+        epoch_config=epoch_config,
     )
 
     # -- pregenerate txs + every validator's votes (untimed) --
@@ -703,13 +740,20 @@ def run_bench(platform: str) -> dict:
     # in a commit certificate.
     byz_frac = float(_cli_or_env("--byzantine-frac", "BENCH_BYZANTINE", "0") or 0)
 
+    # committee mode: ONLY committee members sign — that is the gossip
+    # saving itself (votes per tx = committee size). The latency probe
+    # anchors on the first signer, which is validator 0 only when it made
+    # the sample.
+    probe_vi = signer_idx[0]
+
     def make_corpus(tag: str, count: int):
         txs = [b"%s-%d=v" % (tag.encode(), i) for i in range(count)]
         votes_by_val: list[list[TxVote]] = [[] for _ in range(n_vals)]
         for t_i, tx in enumerate(txs):
             tx_key = hashlib.sha256(tx).digest()
             tx_hash = tx_key.hex().upper()
-            for vi, pv in enumerate(net.priv_vals):
+            for vi in signer_idx:
+                pv = net.priv_vals[vi]
                 vote = TxVote(
                     height=0,
                     tx_hash=tx_hash,
@@ -757,7 +801,9 @@ def run_bench(platform: str) -> dict:
         inject_t: dict[str, float] = {}
         t0 = time.perf_counter()
         chunk_interval = (
-            (chunk_size * n_vals) / pace_votes_per_sec if pace_votes_per_sec else 0.0
+            (chunk_size * len(signer_idx)) / pace_votes_per_sec
+            if pace_votes_per_sec
+            else 0.0
         )
         for i, base in enumerate(range(0, len(txs), chunk_size)):
             if chunk_interval:
@@ -776,10 +822,10 @@ def run_bench(platform: str) -> dict:
             # validators than hosted nodes (configs 2-3) the extra
             # validators' votes arrive as if gossiped in from remote
             # peers, spread across the hosted nodes' ingest points
-            for vi in range(n_vals):
+            for vi in signer_idx:
                 node = net.nodes[vi % len(net.nodes)]
                 vote_chunk = votes_by_val[vi][base : base + chunk_size]
-                if vi == 0:
+                if vi == probe_vi:
                     for vote in vote_chunk:
                         inject_t[vote.tx_hash] = t_chunk
                 node.tx_vote_pool.check_tx_many(vote_chunk)
@@ -847,7 +893,7 @@ def run_bench(platform: str) -> dict:
     # measured on that same axis from phase 1's wall clock — votes_per_sec
     # (committed, summed over nodes) is ~n_nodes x larger and would pace
     # the wrong load (r3 review finding).
-    injected_per_sec = (n_txs * n_vals) / wall
+    injected_per_sec = (n_txs * len(signer_idx)) / wall
     p50 = float("nan")
     if os.environ.get("BENCH_LATENCY", "1") == "1":
         lat_txs = max(64, min(n_txs // 4, 2048))
@@ -901,7 +947,36 @@ def run_bench(platform: str) -> dict:
         # numbers are comparable without re-deriving the power list
         "stake_dist": stake_dist,
         "stake_gini": round(gini(stake_powers), 4),
+        # sublinear-certificate axes (committee/): 0 committee_size =
+        # full-set seed behavior — legacy bank entries without the key
+        # default to 0 on load, so every entry is comparable
+        "committee_size": committee_set.size() if committee_set is not None else 0,
+        "votes_gossiped_per_tx": len(signer_idx),
     }
+    # measured certificate geometry, from committed certs (not the model):
+    # in committee mode vote count must track COMMITTEE quorum, flat in
+    # validator count; in full-set mode this documents the linear cost
+    # the committee config removes
+    from txflow_tpu.types import encode_tx_vote as _enc_vote
+
+    cert_votes = []
+    cert_bytes = []
+    for tx in main_corpus[0][:16]:
+        cvs = net.nodes[0].tx_store.load_tx_votes(
+            hashlib.sha256(tx).hexdigest().upper()
+        )
+        if cvs:
+            cert_votes.append(len(cvs))
+            cert_bytes.append(sum(len(_enc_vote(v)) for v in cvs))
+    if cert_votes:
+        result["cert_votes"] = round(sum(cert_votes) / len(cert_votes), 1)
+        result["cert_bytes"] = round(sum(cert_bytes) / len(cert_bytes))
+    if committee_set is not None and hasattr(shared_verifier, "batch_calls"):
+        # evidence the verify path was the fused one: device dispatches
+        # vs per-signature fallthroughs for small batches
+        result["cert_verify_batch_calls"] = shared_verifier.batch_calls
+        result["cert_verify_scalar_calls"] = shared_verifier.scalar_calls
+        result["cert_verify_batched_votes"] = shared_verifier.batched_votes
     if verifier_kind == "device":
         result["device_step_votes_per_sec"] = device_step_votes_per_sec
     if phase1_rerun:
@@ -1145,7 +1220,49 @@ def _load_banked_tpu() -> dict | None:
         # legacy entries predate the backend label: they all measured
         # the thread backend, stamp it so comparisons are uniform
         entry.setdefault("host_prep_backend", "thread")
+        # legacy entries predate committee sampling: all full-set runs
+        entry.setdefault("committee_size", 0)
         return entry
+    except (OSError, ValueError):
+        return None
+
+
+_COMMITTEE_LATEST = os.path.join(_ARTIFACT_DIR, "committee_latest.json")
+
+
+def _bank_committee_result(result: dict) -> None:
+    """Persist committee-mode measurements in their OWN bank, under the
+    same clean-supersede contract as the default-config tpu bank: a clean
+    run always overwrites, a contaminated run never displaces a clean
+    banked entry. A separate file because committee runs measure a
+    different config axis (committee quorum, member-only gossip) — they
+    must never overwrite the full-set default-config reference, and vice
+    versa. Banked on any platform: the committee_size / cert_votes /
+    votes_gossiped_per_tx geometry is platform-independent evidence."""
+    try:
+        os.makedirs(_ARTIFACT_DIR, exist_ok=True)
+        result = dict(
+            result,
+            measured_at_unix=round(time.time(), 1),
+            contaminated=bool(result.get("compile_in_run")),
+        )
+        existing = _load_banked_committee()
+        if (
+            existing is not None
+            and result["contaminated"]
+            and not _is_contaminated(existing)
+        ):
+            return
+        with open(_COMMITTEE_LATEST, "w") as f:
+            f.write(json.dumps(result))
+    except OSError:
+        pass
+
+
+def _load_banked_committee() -> dict | None:
+    try:
+        with open(_COMMITTEE_LATEST) as f:
+            return json.loads(f.read())
     except (OSError, ValueError):
         return None
 
@@ -1211,6 +1328,11 @@ def _no_cache_companion(platform: str) -> dict | None:
     caller already chose a cache mode explicitly or this IS the companion.
     """
     if os.environ.get("BENCH_COMPANION") == "1":
+        return None
+    if _COMMITTEE_SIZE > 0:
+        # committee mode never uses the shared verify cache (the batch
+        # verifier's one-call-per-batch path is cacheless by design), so
+        # there is no cache/no-cache distinction to measure
         return None
     if "BENCH_SHARE_CACHE" in os.environ:
         return None  # explicit choice: report exactly what was asked
@@ -1321,10 +1443,18 @@ def main():
     if _PROBE_DIAGNOSTICS:
         result["probe_diagnostics"] = _PROBE_DIAGNOSTICS
     if (
+        _COMMITTEE_SIZE > 0
+        and result.get("value", 0) > 0
+        and os.environ.get("BENCH_COMPANION") != "1"
+    ):
+        # committee-mode runs bank in their own file (clean-supersede),
+        # never the default-config tpu bank
+        _bank_committee_result(result)
+    elif (
         result.get("platform") not in (None, "cpu")
         and result.get("value", 0) > 0
         and os.environ.get("BENCH_COMPANION") != "1"
-        and os.environ.get("BENCH_VALIDATORS", "4") == "4"
+        and _N_VALIDATORS == 4
         and os.environ.get("BENCH_CONSENSUS", "0") != "1"
         and float(os.environ.get("BENCH_BYZANTINE", "0")) == 0
         and os.environ.get("BENCH_NODES") is None

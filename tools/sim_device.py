@@ -362,6 +362,81 @@ def print_lane_sweep(arrival_vps: float, fixed_s: float, per_slot_s: float,
           f"(p50 {best['p50_ms']} ms)")
 
 
+def _quorum_votes(n_validators: int) -> int:
+    # equal-stake approximation of >2/3 quorum: smallest vote count
+    # whose stake strictly exceeds 2/3 of total
+    return (2 * n_validators) // 3 + 1
+
+
+def committee_cert_model(n_validators: int, committee_size: int,
+                         fixed_s: float, per_slot_s: float,
+                         host_us_per_vote: float) -> dict:
+    """Per-commit certificate cost at ``n_validators``, with and without
+    per-epoch committee sampling (committee/).
+
+    Full-flood: every validator signs, the certificate carries a >2/3
+    quorum of the FULL set and re-verifies via the per-signature host
+    loop — votes gossiped per tx, cert votes and verify cost all linear
+    in validator count. Committee mode: only the sampled committee signs
+    (cert votes = quorum of COMMITTEE), and the re-check is ONE batched
+    device call (fixed + rung * per_slot) — flat in validator count.
+    162 B/vote is the compact wire cost (32 msg-digest + 64 sig + 64
+    point/scalar material + framing) the bench stamps as cert_bytes."""
+    c = min(committee_size, n_validators) if committee_size > 0 else n_validators
+    full_votes = _quorum_votes(n_validators)
+    com_votes = _quorum_votes(c)
+    rung = 1 << (max(com_votes, 8) - 1).bit_length()
+    return {
+        "validators": n_validators,
+        "committee": c,
+        "full_cert_votes": full_votes,
+        "com_cert_votes": com_votes,
+        "full_verify_ms": round(full_votes * host_us_per_vote / 1e3, 3),
+        "com_verify_ms": round((fixed_s + rung * per_slot_s) * 1e3, 3),
+        "full_gossip_votes_per_tx": n_validators,
+        "com_gossip_votes_per_tx": c,
+        "full_cert_kb": round(full_votes * 162 / 1024, 1),
+        "com_cert_kb": round(com_votes * 162 / 1024, 1),
+    }
+
+
+def print_committee_sweep(fixed_s: float, per_slot_s: float,
+                          host_us_per_vote: float,
+                          sizes=(16, 32, 64)) -> None:
+    """Certificate verify cost vs validator count at committee sizes
+    16/32/64: where the one-batched-call committee re-check crosses
+    below the full-flood per-signature loop, and how cert size / gossip
+    fan-out scale. The 256-validator bench config pins the model's
+    committee=32 column against a live run."""
+    counts = (64, 128, 256, 512, 1024)
+    print(f"committee cert model (fixed={fixed_s * 1e3:.1f} ms, "
+          f"per_slot={per_slot_s * 1e6:.1f} us, "
+          f"host={host_us_per_vote:.1f} us/vote):")
+    hdr = "  validators  full-flood(ms/KB/votes)"
+    for c in sizes:
+        hdr += f"   c={c}(ms/KB)"
+    print(hdr)
+    crossover = {c: None for c in sizes}
+    for n in counts:
+        full = committee_cert_model(n, 0, fixed_s, per_slot_s,
+                                    host_us_per_vote)
+        row = (f"  {n:10d}  {full['full_verify_ms']:8.2f}/"
+               f"{full['full_cert_kb']:5.1f}/{full['full_cert_votes']:4d}")
+        for c in sizes:
+            m = committee_cert_model(n, c, fixed_s, per_slot_s,
+                                     host_us_per_vote)
+            row += f"  {m['com_verify_ms']:7.2f}/{m['com_cert_kb']:4.1f}"
+            if crossover[c] is None and m["com_verify_ms"] < m["full_verify_ms"]:
+                crossover[c] = n
+        print(row)
+    for c in sizes:
+        n = crossover[c]
+        where = f"{n} validators" if n is not None else "beyond swept range"
+        print(f"  crossover c={c}: committee batched verify beats "
+              f"full-flood host loop from {where} "
+              f"(committee cost flat, full-flood linear)")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--fixed-ms", type=float, default=8.0)
@@ -390,11 +465,20 @@ def main():
                     help="priority-lane offered load for --lane-sweep")
     ap.add_argument("--lane-bucket-cap", type=int, default=512,
                     help="priority_bucket_cap for --lane-sweep")
+    ap.add_argument("--committee-sweep", action="store_true",
+                    help="print the committee certificate model: verify "
+                         "cost / cert bytes / gossip fan-out vs validator "
+                         "count at committee sizes 16/32/64, with the "
+                         "crossover vs the full-flood host loop")
     args = ap.parse_args()
     if args.lane_sweep:
         print_lane_sweep(args.lane_arrival_vps, args.fixed_ms / 1e3,
                          args.per_slot_us / 1e6, args.mesh_devices,
                          args.lane_bucket_cap)
+        return
+    if args.committee_sweep:
+        print_committee_sweep(args.fixed_ms / 1e3, args.per_slot_us / 1e6,
+                              args.host_us_per_vote)
         return
     for shared in (True, False):
         r = run(shared, args.txs, args.fixed_ms / 1e3, args.per_slot_us / 1e6,

@@ -267,6 +267,9 @@ _DETERMINISM_SCOPE = (
     "txflow_tpu/types/vote_set.py",
     "txflow_tpu/engine/txflow.py",
     "txflow_tpu/consensus/",
+    # committee election must be identical on every node — any clock or
+    # rng leak here forks the committee (and thus the quorum) silently
+    "txflow_tpu/committee/",
 )
 
 _CLOCK_SEAM = "txflow_tpu/utils/clock.py"
@@ -475,6 +478,16 @@ _HOT_NOBLOCK_FUNCS = {
         "register_peer", "note_sync_strike", "strikes_of",
         "_judge_locked", "_trip_locked",
     },
+    # committee resolution sits on the vote-gossip pre-check path (the
+    # reactor's StateView.committee read resolves through these on every
+    # epoch swap) and inside the engine's update_state: a cache miss
+    # re-samples with pure sha256 arithmetic — never a lock wait, never
+    # I/O. One blocking call here stalls every gossip receive thread at
+    # once at the epoch boundary.
+    "txflow_tpu/committee/sampler.py": {
+        "sample_committee", "committee_seed", "committee_at",
+        "for_vote_height", "epoch_for_vote_height",
+    },
 }
 
 
@@ -548,6 +561,9 @@ _TRACE_SCOPE = (
     "txflow_tpu/pool/",
     "txflow_tpu/reactors/",
     "txflow_tpu/sync/",
+    # committee sampling + batched cert verify ride the reactor pre-check
+    # and sync verify paths above — same traced timeline, same seam
+    "txflow_tpu/committee/",
     # weather timestamps (due times, flap schedule) must share the traced
     # timeline: a pinned-clock test that shapes links would otherwise see
     # deliveries scheduled on a clock the spans don't use

@@ -1,0 +1,37 @@
+"""Per-epoch committee sampling + device-batched certificate verify.
+
+Sublinear certificates (ROADMAP): at 256+ validators the full-flood
+design commits ~171-vote certificates — vote gossip, store bytes and
+verify work all linear in validator count. This package caps all three
+at committee size:
+
+- ``sampler``: deterministic stake-proportional committee election per
+  epoch (sha256 domain over ``(chain_id, epoch)``), derived identically
+  on every node with no extra messages. The committee is an ordinary
+  ``ValidatorSet`` (members keep their powers), so committee quorum is
+  its own ``quorum_power()`` and every tally / revalidate / restage
+  path downstream works unchanged.
+- ``certverify``: a drop-in ``ScalarVoteVerifier`` that verifies a
+  whole certificate batch as ONE ``ed25519_batch`` device call per
+  val-set fingerprint (the sync/follower re-check path, and an engine
+  verifier for committee benches).
+
+Opt-in via ``EpochConfig.committee_size``; full-set mode stays the
+default and keeps certificate byte-parity with the scalar golden path.
+"""
+
+from .certverify import BatchCertVerifier
+from .sampler import (
+    SEED_DOMAIN,
+    CommitteeSchedule,
+    committee_seed,
+    sample_committee,
+)
+
+__all__ = [
+    "BatchCertVerifier",
+    "CommitteeSchedule",
+    "SEED_DOMAIN",
+    "committee_seed",
+    "sample_committee",
+]

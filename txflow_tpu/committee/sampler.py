@@ -1,0 +1,164 @@
+"""Deterministic stake-proportional per-epoch committee sampling.
+
+"A verifiably secure and proportional committee election rule" (arxiv
+2004.12990): instead of every validator signing every tx (certificates
+carry the full 2n/3 vote set, so verify work / gossip bandwidth / store
+bytes grow linearly in validator count), each epoch elects a small
+stake-proportional *voting committee* and only committee members sign
+tx votes. The committee quorum is >2/3 of COMMITTEE stake, so
+certificate size and verify cost are flat in validator count.
+
+Election must be message-free and identical on every node, so it is a
+pure function of public chain state: weighted draws WITHOUT replacement
+over the epoch's validator set, each draw consuming one sha256 of
+``seed || counter`` where the seed is a domain-separated digest of
+``(chain_id, epoch)``. Everything is integer arithmetic over the set's
+deterministic (address-sorted) order — no floats, no process rng, no
+iteration over hash-seeded containers (txlint's determinism pass covers
+this module).
+
+Safety floors: a committee below ``min_size`` members (or the full set,
+when the set itself is that small) is cheap to corrupt, and under
+long-tail stake tables a member-count target alone can under-represent
+stake — ``min_stake_frac`` keeps drawing past the size target until the
+sample holds that fraction of total power. Members keep their ORIGINAL
+voting powers: the committee is an ordinary ``ValidatorSet``, so every
+downstream tally / quorum / revalidate / restage path works unchanged.
+
+Slashed validators are excluded implicitly: slashing removes them from
+the epoch's validator set (power 0 = removed at the boundary fold), and
+the sampler only ever draws from the set it is handed. Nothing here
+reads EpochManager state — a restarted node re-deriving the committee
+from (config, committed chain) must land on the identical sample.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..types.validator import ValidatorSet
+
+# Domain-separation tag: versioned so a future sampler change cannot
+# silently elect a different committee for the same (chain_id, epoch)
+SEED_DOMAIN = b"txflow/committee/v1"
+
+
+def committee_seed(chain_id: str, epoch: int) -> bytes:
+    """The per-epoch sampling seed: sha256 over the domain tag,
+    chain_id, and epoch number. Public inputs only — every node derives
+    the identical seed with no extra messages."""
+    h = hashlib.sha256()
+    h.update(SEED_DOMAIN)
+    h.update(b"|")
+    h.update(chain_id.encode())
+    h.update(b"|")
+    h.update(int(epoch).to_bytes(8, "big"))
+    return h.digest()
+
+
+def _draw(seed: bytes, counter: int, bound: int) -> int:
+    """Deterministic integer in [0, bound): sha256(seed || counter).
+
+    The modulo bias over a 256-bit draw is < 2**-200 for any realistic
+    stake total — negligible against the sampling guarantee (and, more
+    importantly, identical on every node)."""
+    d = hashlib.sha256(seed + counter.to_bytes(8, "big")).digest()
+    return int.from_bytes(d, "big") % bound
+
+
+def sample_committee(
+    full_set: ValidatorSet,
+    chain_id: str,
+    epoch: int,
+    size: int,
+    min_size: int = 4,
+    min_stake_frac: float = 0.0,
+) -> ValidatorSet:
+    """The epoch's committee: stake-proportional draws without
+    replacement from ``full_set`` until both floors are met.
+
+    Returns ``full_set`` itself when the target (after the size floor)
+    covers the whole set — full-set mode and committee mode then share
+    the identity fast-path in the engine's content-hash rotation check.
+    """
+    n = full_set.size()
+    target = max(int(size), int(min_size), 1)
+    if target >= n:
+        return full_set
+    total = full_set.total_voting_power()
+    # integer floor target: ceil(frac * total) without float accumulation
+    # in the loop (one float multiply here is reproducible across nodes —
+    # IEEE754 is deterministic — but keep the comparison integral)
+    floor_stake = -(-int(min_stake_frac * total * 2**20) // 2**20) if min_stake_frac > 0 else 0
+    floor_stake = min(floor_stake, total)
+
+    seed = committee_seed(chain_id, epoch)
+    # address-sorted order (the ValidatorSet invariant) makes the
+    # cumulative walk deterministic across nodes
+    remaining = list(full_set.validators)
+    weights = [v.voting_power for v in remaining]
+    rem_total = total
+    chosen = []
+    chosen_stake = 0
+    counter = 0
+    while remaining and (len(chosen) < target or chosen_stake < floor_stake):
+        r = _draw(seed, counter, rem_total)
+        counter += 1
+        acc = 0
+        j = 0
+        for j, w in enumerate(weights):
+            acc += w
+            if r < acc:
+                break
+        v = remaining.pop(j)
+        w = weights.pop(j)
+        rem_total -= w
+        chosen.append(v)
+        chosen_stake += w
+    return ValidatorSet(chosen)
+
+
+class CommitteeSchedule:
+    """Per-node committee resolver: (vote height, full set) -> committee.
+
+    A vote at height ``h`` certifies a tx that commits in block ``h+1``,
+    so the committee in force for votes at ``h`` is the one of
+    ``epoch_of(h+1)`` — the same mapping the sync client applies when it
+    re-verifies a fetched certificate whose votes carry height ``h``.
+    With ``length == 0`` every height maps to epoch 0: a static
+    committee, the fast-path bench posture.
+
+    The tiny cache is keyed by (epoch, full-set hash): a slashing or
+    scheduled rotation changes the full set's hash, so a stale sample
+    can never be served for a rotated set. Benign races recompute the
+    same deterministic sample; ``setdefault`` keeps one object so the
+    engine's identity/content-hash rotation check sees a stable set.
+    """
+
+    def __init__(self, chain_id: str, cfg):
+        self.chain_id = chain_id
+        self.cfg = cfg
+        self._cache: dict[tuple, ValidatorSet] = {}
+
+    def epoch_for_vote_height(self, height: int) -> int:
+        return self.cfg.epoch_of(height + 1)
+
+    def committee_at(self, epoch: int, full_set: ValidatorSet) -> ValidatorSet:
+        key = (epoch, full_set.hash())
+        c = self._cache.get(key)
+        if c is None:
+            c = sample_committee(
+                full_set,
+                self.chain_id,
+                epoch,
+                self.cfg.committee_size,
+                min_size=self.cfg.committee_min_size,
+                min_stake_frac=self.cfg.committee_min_stake_frac,
+            )
+            if len(self._cache) > 8:
+                self._cache.clear()  # epoch churn: keep the cache tiny
+            c = self._cache.setdefault(key, c)
+        return c
+
+    def for_vote_height(self, height: int, full_set: ValidatorSet) -> ValidatorSet:
+        return self.committee_at(self.epoch_for_vote_height(height), full_set)

@@ -32,6 +32,31 @@ class EpochConfig:
     # ``ValidatorSet.update_with_change_set`` contract.
     schedule: dict = field(default_factory=dict)
 
+    # per-epoch tx-vote committee sampling (committee/): 0 (default)
+    # disables — every validator signs and certificates carry the full
+    # 2n/3 vote set (seed behavior, byte-parity with the scalar golden
+    # path). When > 0, each epoch's tx-vote committee is the
+    # deterministic stake-proportional sample of that epoch's validator
+    # set, seeded by sha256 over (chain_id, epoch) so every node derives
+    # the identical committee with no extra messages; certificates then
+    # carry >2/3 of COMMITTEE stake and verify cost is flat in validator
+    # count. Works with length=0 too (a static epoch-0 committee).
+    committee_size: int = 0
+
+    # safety floor on committee size: the sample never holds fewer than
+    # this many members (and is the full set whenever the full set is at
+    # or below the floor) — a tiny committee is cheap to corrupt
+    committee_min_size: int = 4
+
+    # safety floor on committee stake: keep drawing past committee_size
+    # until the sample holds at least this fraction of the full set's
+    # total power (0.0 = size target only). Guards long-tail stake
+    # tables where `committee_size` minnows could under-represent stake.
+    committee_min_stake_frac: float = 0.0
+
+    def committee_enabled(self) -> bool:
+        return self.committee_size > 0
+
     def epoch_of(self, height: int) -> int:
         """Epoch containing ``height`` (0-based; heights start at 1)."""
         if self.length <= 0 or height <= 0:

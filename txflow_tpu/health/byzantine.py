@@ -57,8 +57,21 @@ DROP_UNKNOWN_VALIDATOR = "unknown_validator"
 DROP_STALE_HEIGHT = "stale_height"
 DROP_REPLAYED_SIG = "replayed_sig"
 DROP_QUARANTINED = "quarantined"
+# committee mode only: the signer is a real validator but not in the
+# epoch's sampled tx-vote committee. Honest peers never relay these —
+# non-committee votes are pre-dropped at every hop and never enter the
+# pool or the wire cache — so an exact-height non-committee vote is
+# manufactured traffic and feeds the breaker.
+DROP_NON_COMMITTEE = "non_committee"
 
-_BREAKER_REASONS = (DROP_UNKNOWN_VALIDATOR, DROP_STALE_HEIGHT)
+_BREAKER_REASONS = (DROP_UNKNOWN_VALIDATOR, DROP_STALE_HEIGHT, DROP_NON_COMMITTEE)
+
+# committee_rescale floors: scaling the breaker thresholds down by the
+# committee fraction must never make the breaker hair-triggered — below
+# these, one honest race (e.g. a vote crossing an epoch boundary in
+# flight) could quarantine a well-behaved peer.
+_MIN_SAMPLES_FLOOR = 8
+_BAD_RATE_FLOOR = 0.2
 
 
 @dataclass
@@ -127,6 +140,12 @@ class ByzantineLedger:
         self.cfg = cfg or ByzantineConfig()
         self.scoreboard = scoreboard
         self.metrics = ByzantineMetrics(metrics_registry)
+        # committee fraction for the breaker thresholds (1.0 = full-set
+        # mode). Stored as a FRACTION, not precomputed thresholds: the
+        # soak/drill rigs arm the breaker by mutating cfg.min_samples at
+        # runtime, so the effective values must be derived from the live
+        # cfg at judge time — see _eff_thresholds / committee_rescale.
+        self._committee_frac = 1.0
         self._mtx = make_lock("health.ByzantineLedger._mtx")
         self._peers: dict[str, _PeerRecord] = {}
         self._pids: dict[int, str] = {}  # pool sender id -> node_id
@@ -134,6 +153,36 @@ class ByzantineLedger:
         self._total_strikes = 0
         self._total_quarantines = 0
         self._total_pre_drops = 0
+
+    # -- committee scaling (epoch boundary, committee mode only) --
+
+    def committee_rescale(self, fraction: float) -> tuple[int, float]:
+        """Restate the breaker thresholds in committee terms: when only
+        ``fraction`` of validators sign tx votes, a flooding peer's
+        judged-event stream shrinks by the same fraction, so the
+        configured full-set thresholds would take 1/fraction as long to
+        trip. Scale ``min_samples`` and ``max_bad_rate`` by the
+        committee fraction (floors keep the breaker from turning
+        hair-triggered at tiny committees). Called by the node at each
+        epoch boundary with ``committee.size / full_set.size``; a
+        fraction >= 1.0 (full-set mode) restores the configured values.
+        Returns the effective ``(min_samples, max_bad_rate)``."""
+        f = min(max(float(fraction), 0.0), 1.0)
+        with self._mtx:
+            self._committee_frac = f
+        return self._eff_thresholds()
+
+    def _eff_thresholds(self) -> tuple[int, float]:
+        """Effective breaker thresholds under the current committee
+        fraction, derived from the LIVE cfg values (drills arm the
+        breaker by mutating cfg mid-run)."""
+        f = self._committee_frac
+        if f >= 1.0:
+            return self.cfg.min_samples, self.cfg.max_bad_rate
+        return (
+            max(_MIN_SAMPLES_FLOOR, int(round(self.cfg.min_samples * f))),
+            max(_BAD_RATE_FLOOR, self.cfg.max_bad_rate * f),
+        )
 
     # -- peer identity --
 
@@ -272,9 +321,10 @@ class ByzantineLedger:
         cfg = self.cfg
         trip = None
         if now >= rec.quarantined_until:
+            eff_min, eff_rate = self._eff_thresholds()
             bad_trip = (
-                rec.win_events >= cfg.min_samples
-                and rec.win_bad / rec.win_events >= cfg.max_bad_rate
+                rec.win_events >= eff_min
+                and rec.win_bad / rec.win_events >= eff_rate
             )
             replay_trip = (
                 cfg.quarantine_replays
@@ -344,6 +394,12 @@ class ByzantineLedger:
                 "quarantines": self._total_quarantines,
                 "pre_verify_drops": self._total_pre_drops,
                 "quarantined_peers": quarantined,
+                "breaker": dict(
+                    zip(
+                        ("min_samples", "max_bad_rate"),
+                        self._eff_thresholds(),
+                    )
+                ),
                 "peers": peers,
             }
         self.metrics.quarantined_peers.set(float(len(quarantined)))

@@ -260,6 +260,27 @@ class Node:
                 nc.epoch_config, metrics=EpochMetrics(self.metrics_registry)
             )
 
+        # -- committee sampling (committee/): per-epoch stake-proportional
+        # tx-vote committee, derived deterministically from (chain_id,
+        # epoch) on every node. Independent of the epoch_manager gate:
+        # length=0 + committee_size>0 is a valid static-committee posture
+        # (the bench config). Full-set mode (committee_size=0, default)
+        # leaves all of this None — zero behavior change --
+        self.committee_schedule = None
+        self._committee = None
+        if nc.epoch_config is not None and getattr(
+            nc.epoch_config, "committee_size", 0
+        ) > 0:
+            from ..committee import CommitteeSchedule
+
+            self.committee_schedule = CommitteeSchedule(chain_id, nc.epoch_config)
+            self._committee = self.committee_schedule.for_vote_height(
+                self._last_block_height, self._val_set
+            )
+            self.byzantine_ledger.committee_rescale(
+                self._committee.size() / max(self._val_set.size(), 1)
+            )
+
         # -- admission front door (admission/): sits between the RPC/
         # gossip edges and the mempool; also supplies the pool's lane
         # classifier so every ingress path lands txs in the right lane --
@@ -297,11 +318,16 @@ class Node:
         engine_cfg = dataclasses.replace(
             self.config.engine, use_device=nc.use_device_verifier
         )
+        # committee mode: the engine's tally set IS the committee — its
+        # quorum_power() is the committee quorum, and a constant committee
+        # size keeps the device verifier's compile shapes constant across
+        # epoch swaps (zero-recompile restage)
+        engine_vals = self._committee if self._committee is not None else self._val_set
         if verifier is None and nc.use_device_verifier and mesh is not None:
             from ..verifier import DeviceVoteVerifier, ResilientVoteVerifier
 
             verifier = DeviceVoteVerifier(
-                val_set, mesh=mesh,
+                engine_vals, mesh=mesh,
                 host_prep_workers=int(engine_cfg.host_prep_workers or 0),
             )
             if nc.resilient_verifier:
@@ -309,7 +335,7 @@ class Node:
         self.txflow = TxFlow(
             chain_id,
             self._last_block_height,
-            self._val_set,
+            engine_vals,
             self.tx_vote_pool,
             self.mempool,
             self.commitpool,
@@ -497,6 +523,7 @@ class Node:
                 metrics=SyncMetrics(self.metrics_registry),
                 tracer=self.tracer,
                 ledger=self.byzantine_ledger,
+                committee=self.committee_schedule,
             )
             self.sync_reactor.manager = self.sync_manager
             self.switch.add_reactor("sync", self.sync_reactor)
@@ -517,7 +544,30 @@ class Node:
 
     def state_view(self) -> StateView:
         with self._state_mtx:
-            return StateView(self.chain_id, self._last_block_height, self._val_set)
+            return StateView(
+                self.chain_id,
+                self._last_block_height,
+                self._val_set,
+                committee=self._committee,
+            )
+
+    def _engine_val_set(self, height: int, full: ValidatorSet) -> ValidatorSet:
+        """The set the engine tallies against at ``height``: the epoch's
+        sampled committee in committee mode, the full set otherwise.
+        Tracks ``self._committee`` (the reactor pre-check view) and
+        restates the breaker thresholds whenever the committee actually
+        changes (epoch boundary or slash-rotated full set)."""
+        if self.committee_schedule is None:
+            return full
+        committee = self.committee_schedule.for_vote_height(height, full)
+        with self._state_mtx:
+            changed = committee is not self._committee
+            self._committee = committee
+        if changed:
+            self.byzantine_ledger.committee_rescale(
+                committee.size() / max(full.size(), 1)
+            )
+        return committee
 
     def update_state(self, height: int, val_set: ValidatorSet | None = None) -> None:
         """Block boundary: advance height / rotate validators."""
@@ -525,7 +575,8 @@ class Node:
             self._last_block_height = height
             if val_set is not None:
                 self._val_set = val_set
-        self.txflow.update_state(height, val_set or self._val_set)
+            full = self._val_set
+        self.txflow.update_state(height, self._engine_val_set(height, full))
         self.txvote_reactor.broadcast_height(height)
         self.mempool_reactor.broadcast_height(height)
         self.evidence_pool.prune(height)
@@ -581,7 +632,10 @@ class Node:
                 self._last_block_height = new_state.last_block_height
                 self._val_set = new_state.validators
             self.txflow.update_state(
-                new_state.last_block_height, new_state.validators
+                new_state.last_block_height,
+                self._engine_val_set(
+                    new_state.last_block_height, new_state.validators
+                ),
             )
             if self.consensus is not None:
                 self.consensus.reset_to_state(new_state)

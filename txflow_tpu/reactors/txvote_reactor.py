@@ -63,6 +63,10 @@ class StateView:
     chain_id: str
     last_block_height: int
     validators: ValidatorSet
+    # committee mode (committee/): the epoch's sampled tx-vote committee
+    # for votes at last_block_height. None = full-set mode — every
+    # validator signs, no committee pre-check.
+    committee: ValidatorSet | None = None
 
 
 def encode_vote_batch(votes: list[TxVote]) -> bytes:
@@ -275,7 +279,7 @@ class TxVoteReactor(Reactor):
                     fresh_slots, decode_tx_votes_many(fresh_segs)
                 ):
                     ingest[slot] = (ingest[slot][0], vote)
-            n_unknown = n_stale = 0
+            n_unknown = n_stale = n_noncomm = 0
             if ingest and ledger is not None:
                 # O(1)-per-vote pre-checks, BEFORE the pool and the
                 # device: a vote from a signer outside the validator set
@@ -287,6 +291,7 @@ class TxVoteReactor(Reactor):
                 # and re-counted against the sender.
                 st = self.get_state()
                 vals = st.validators
+                committee = st.committee
                 min_height = st.last_block_height - ledger.cfg.stale_height_slack
                 kept = []
                 tr = self.tracer
@@ -295,6 +300,19 @@ class TxVoteReactor(Reactor):
                         n_unknown += 1
                     elif vote.height < min_height:
                         n_stale += 1
+                    elif (
+                        committee is not None
+                        and vote.height == st.last_block_height
+                        and not committee.has_address(vote.validator_address)
+                    ):
+                        # committee mode: a real validator signing a
+                        # current-height tx vote from OUTSIDE the epoch's
+                        # sampled committee can never reach committee
+                        # quorum — O(1) drop before the pool and device.
+                        # Gated on exact height: a vote straddling an
+                        # epoch boundary belongs to another epoch's
+                        # committee and is left to the tally to judge.
+                        n_noncomm += 1
                     else:
                         kept.append((wk, vote))
                         continue
@@ -315,13 +333,15 @@ class TxVoteReactor(Reactor):
                     if err is not None and isinstance(err, ErrTxInCache):
                         peer.stats.duplicates += 1
             if ledger is not None and (
-                ingest or n_unknown or n_stale or n_replayed
+                ingest or n_unknown or n_stale or n_noncomm or n_replayed
             ):
                 drops = {}
                 if n_unknown:
                     drops["unknown_validator"] = n_unknown
                 if n_stale:
                     drops["stale_height"] = n_stale
+                if n_noncomm:
+                    drops["non_committee"] = n_noncomm
                 if n_replayed:
                     drops["replayed_sig"] = n_replayed
                 ledger.note_frame(peer.node_id, len(ingest), drops or None)
@@ -362,6 +382,12 @@ class TxVoteReactor(Reactor):
             my_addr = self.priv_val.get_address()
             if not st.validators.has_address(my_addr):
                 continue  # keep running: could become a validator any round
+            if st.committee is not None and not st.committee.has_address(my_addr):
+                # committee mode: only committee members sign tx votes —
+                # this is WHERE the gossip savings come from (votes per tx
+                # = committee size, not validator count). Keep running:
+                # the next epoch's sample may include us.
+                continue
             tr = self.tracer
             for tx_key, tx, _h, fast_path, _lane in items:
                 if not fast_path:

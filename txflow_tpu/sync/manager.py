@@ -114,6 +114,7 @@ class SyncManager:
         metrics=None,  # SyncMetrics | None
         tracer=None,
         ledger=None,  # health.byzantine.ByzantineLedger | None
+        committee=None,  # committee.CommitteeSchedule | None
     ):
         self.chain_id = chain_id
         self.tx_store = tx_store
@@ -130,6 +131,12 @@ class SyncManager:
         # the node-wide ledger, which also quarantines the liar's VOTE
         # traffic — one /health section, one metrics family
         self.ledger = ledger
+        # committee mode (committee/): fetched certificates carry only
+        # committee votes, so re-verification must tally against the
+        # epoch's sampled committee (same vote-height -> epoch mapping the
+        # engine uses) or maj23 would fail against the full-set quorum.
+        # None = full-set mode, the seed verify path bit-for-bit.
+        self.committee = committee
         self._rng = random.Random(self.config.seed)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -548,7 +555,16 @@ class SyncManager:
         if v is None:
             if len(self._verifiers) > 8:
                 self._verifiers.clear()  # epoch churn: keep the cache tiny
-            v = self._verifiers[fp] = ScalarVoteVerifier(vals)
+            if self.committee is not None:
+                # committee mode: a whole response's certificates verify
+                # as ONE ed25519_batch device call per val-set group
+                # instead of a per-signature host loop (identical
+                # decisions — BatchCertVerifier is a ScalarVoteVerifier)
+                from ..committee import BatchCertVerifier
+
+                v = self._verifiers[fp] = BatchCertVerifier(vals)
+            else:
+                v = self._verifiers[fp] = ScalarVoteVerifier(vals)
         return v
 
     def _verify_apply(self, peer, entries: list, snapshots: dict) -> int:
@@ -622,7 +638,17 @@ class SyncManager:
                 # certificate's proven signers chain back to a quorum of
                 # the nearest set we DO trust (endorsement pass below)
                 vals, unchained = claimed, True
-            parsed.append((tx_hash, votes, tx, tx_key, vals, height, unchained))
+            full_vals = vals
+            if self.committee is not None:
+                # committee mode: the certificate was formed by the
+                # epoch's sampled committee — tally against it (its own
+                # quorum), derived deterministically from the full set in
+                # force at this height. full_vals is kept for the trust
+                # pin: _learn_vals records FULL sets, never samples.
+                vals = self.committee.for_vote_height(height, vals)
+            parsed.append(
+                (tx_hash, votes, tx, tx_key, vals, height, unchained, full_vals)
+            )
         # batched verify, grouped by validator set (one group per epoch)
         groups: dict[tuple, list[int]] = {}
         for i, p in enumerate(parsed):
@@ -638,7 +664,7 @@ class SyncManager:
             val_idx: list[int] = []
             tx_slot: list[int] = []
             for slot, i in enumerate(idxs):
-                _h, votes, _tx, _k, _vals, _height, _u = parsed[i]
+                _h, votes, _tx, _k, _vals, _height, _u, _fv = parsed[i]
                 vb = sign_bytes_many(votes, self.chain_id)
                 for v, sb in zip(votes, vb):
                     vi = addr_to_idx.get(v.validator_address)
@@ -688,8 +714,16 @@ class SyncManager:
         for p in parsed:
             if p is None or not p[6]:
                 continue
-            _h, votes, _tx, _k, _vals, height, _u = p
-            if not self._endorsed(votes, self._anchor_for(height)):
+            _h, votes, _tx, _k, _vals, height, _u, _fv = p
+            anchor = self._anchor_for(height)
+            if self.committee is not None:
+                # the signers ARE the committee: endorsement means they
+                # carry a quorum of the trusted anchor's COMMITTEE —
+                # which derives deterministically from the anchor, so
+                # endorsing the sample transitively endorses the claimed
+                # full set it was drawn from
+                anchor = self.committee.for_vote_height(height, anchor)
+            if not self._endorsed(votes, anchor):
                 # NOT a Byzantine strike: our own record may simply be
                 # too stale to chain across the rotation — fail the
                 # round; the consensus-block fallback remains the path
@@ -702,7 +736,7 @@ class SyncManager:
         # verified resolves locally from now on (and across restarts)
         for p in parsed:
             if p is not None:
-                self._learn_vals(p[5], p[4])
+                self._learn_vals(p[5], p[7])
         span_hash = self._first_sampled(entries)
         if span_hash is not None:
             self.tracer.span(span_hash, SPAN_SYNC_VERIFY, t_verify0, monotonic())
@@ -716,7 +750,7 @@ class SyncManager:
         for p in parsed:
             if p is None:
                 continue
-            tx_hash, votes, tx, tx_key, vals, _height, _u = p
+            tx_hash, votes, tx, tx_key, vals, _height, _u, _fv = p
             t0 = monotonic()
             vs = TxVoteSet(self.chain_id, votes[0].height, tx_hash, tx_key, vals)
             for v in votes:

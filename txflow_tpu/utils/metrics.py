@@ -439,12 +439,14 @@ class ByzantineMetrics:
         self.drop_stale_height = r.counter("byzantine", "drop_stale_height", "votes dropped pre-verify: height behind the stale slack")
         self.drop_replayed_sig = r.counter("byzantine", "drop_replayed_sig", "votes dropped pre-verify: same peer re-sent an identical signature")
         self.drop_quarantined = r.counter("byzantine", "drop_quarantined", "vote segments dropped whole-frame from quarantined peers")
+        self.drop_non_committee = r.counter("byzantine", "drop_non_committee", "votes dropped pre-verify: signer not in the epoch's tx-vote committee")
         self.quarantined_peers = r.gauge("byzantine", "quarantined_peers", "peers currently under vote-traffic quarantine")
         self.drop_counters = {
             "unknown_validator": self.drop_unknown_validator,
             "stale_height": self.drop_stale_height,
             "replayed_sig": self.drop_replayed_sig,
             "quarantined": self.drop_quarantined,
+            "non_committee": self.drop_non_committee,
         }
 
 
