@@ -1357,6 +1357,38 @@ def _no_cache_companion(platform: str) -> dict | None:
         return {"error": repr(e)[:200]}
 
 
+def _stamp_lint(result: dict) -> None:
+    """Stamp the tree's static-analysis posture into the result.
+
+    A banked measurement is only trustworthy if the code that produced it
+    held the repo invariants the txlint passes encode (no hot-loop syncs,
+    no recompile hazards, ...). The digest fingerprints the lint REPORT —
+    rule inventory plus every (path, line, rule) finding — so two results
+    with equal digests ran under the identical lint verdict, and a result
+    from a dirty tree says so on its face. Never fails the bench."""
+    try:
+        from txflow_tpu.analysis import core as _lint_core
+
+        report = _lint_core.lint_tree(os.path.dirname(os.path.abspath(__file__)))
+        blob = json.dumps(
+            {
+                "rules": sorted(_lint_core.RULES),
+                "violations": [
+                    [v.path, v.line, v.rule] for v in report["violations"]
+                ],
+                "suppressed": len(report["suppressed"]),
+                "files": report["files_scanned"],
+            },
+            sort_keys=True,
+        )
+        result["lint"] = {
+            "clean": not report["violations"] and not report["errors"],
+            "digest": hashlib.sha256(blob.encode()).hexdigest()[:12],
+        }
+    except Exception as e:  # pragma: no cover - never block a measurement
+        result["lint"] = {"clean": None, "error": repr(e)[:120]}
+
+
 def main():
     platform = _resolve_platform()
     if "--latency-slo" in sys.argv:
@@ -1377,6 +1409,7 @@ def main():
         if budget is not None:
             result["slo_p99_ms"] = float(budget)
             result["slo_breach"] = slo_breached(result, budget)
+        _stamp_lint(result)
         _bank_latency_result(result)
         print(json.dumps(result))
         if result.get("slo_breach"):
@@ -1442,6 +1475,8 @@ def main():
         }
     if _PROBE_DIAGNOSTICS:
         result["probe_diagnostics"] = _PROBE_DIAGNOSTICS
+    # stamp before banking so bank entries carry the lint posture too
+    _stamp_lint(result)
     if (
         _COMMITTEE_SIZE > 0
         and result.get("value", 0) > 0

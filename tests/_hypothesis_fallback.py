@@ -1,0 +1,142 @@
+"""Deterministic mini-harness standing in for hypothesis.
+
+tests/test_fuzz_codec.py prefers the real hypothesis (listed in
+requirements-dev.txt): full strategy library, shrinking, example
+database. On boxes without it, this shim keeps the fuzz bodies RUNNING
+in tier-1 instead of skipping — seeded pseudo-random examples, no
+shrinking, same test code. Only the exact API surface the fuzz file uses
+is implemented (given/settings + binary/text/integers/composite/data);
+anything else raises so accidental divergence is loud.
+
+Determinism: every test draws from ``random.Random(sha256(test name))``,
+so a failure reproduces by re-running the test — the property the
+hypothesis example database provides, minus shrinking.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import random
+
+# fallback runs trade example count for tier-1 wall time; the real
+# hypothesis honors the test's own max_examples
+_MAX_EXAMPLES_CAP = 120
+
+
+class Strategy:
+    def __init__(self, draw_fn):
+        self._draw = draw_fn
+
+
+def binary(min_size: int = 0, max_size: int | None = None) -> Strategy:
+    hi = max_size if max_size is not None else max(min_size, 64)
+
+    def draw(rng: random.Random):
+        n = rng.randint(min_size, hi)
+        return rng.getrandbits(8 * n).to_bytes(n, "big") if n else b""
+
+    return Strategy(draw)
+
+
+def integers(min_value=None, max_value=None) -> Strategy:
+    lo = min_value if min_value is not None else -(2**63)
+    hi = max_value if max_value is not None else 2**63 - 1
+
+    def draw(rng: random.Random):
+        # bias toward the edges: codec bugs live at 0 / max / length caps
+        r = rng.random()
+        if r < 0.1:
+            return lo
+        if r < 0.2:
+            return hi
+        return rng.randint(lo, hi)
+
+    return Strategy(draw)
+
+
+def text(alphabet: str, min_size: int = 0, max_size: int | None = None) -> Strategy:
+    hi = max_size if max_size is not None else max(min_size, 32)
+
+    def draw(rng: random.Random):
+        n = rng.randint(min_size, hi)
+        return "".join(rng.choice(alphabet) for _ in range(n))
+
+    return Strategy(draw)
+
+
+def composite(fn):
+    @functools.wraps(fn)
+    def make(*args, **kwargs):
+        def draw_value(rng: random.Random):
+            return fn(lambda s: s._draw(rng), *args, **kwargs)
+
+        return Strategy(draw_value)
+
+    return make
+
+
+class _Data:
+    def __init__(self, rng: random.Random):
+        self._rng = rng
+
+    def draw(self, strategy: Strategy):
+        return strategy._draw(self._rng)
+
+
+def data() -> Strategy:
+    return Strategy(lambda rng: _Data(rng))
+
+
+def settings(max_examples: int = 100, deadline=None):
+    def deco(fn):
+        fn._fallback_max_examples = max_examples
+        return fn
+
+    return deco
+
+
+def given(*strategies: Strategy):
+    def deco(fn):
+        n = min(
+            getattr(fn, "_fallback_max_examples", 100), _MAX_EXAMPLES_CAP
+        )
+
+        # deliberately NOT functools.wraps: pytest would introspect the
+        # wrapped signature via __wrapped__ and demand fixtures for the
+        # strategy parameters
+        def runner():
+            seed = hashlib.sha256(fn.__name__.encode()).digest()
+            rng = random.Random(int.from_bytes(seed[:8], "big"))
+            for i in range(n):
+                drawn = tuple(s._draw(rng) for s in strategies)
+                try:
+                    fn(*drawn)
+                except Exception as e:
+                    raise AssertionError(
+                        f"{fn.__name__} failed on fallback example "
+                        f"{i}/{n}: args={drawn!r}"
+                    ) from e
+
+        runner.__name__ = fn.__name__
+        runner.__doc__ = fn.__doc__
+        return runner
+
+    return deco
+
+
+class _St:
+    binary = staticmethod(binary)
+    integers = staticmethod(integers)
+    text = staticmethod(text)
+    composite = staticmethod(composite)
+    data = staticmethod(data)
+
+    def __getattr__(self, name):  # loud on unimplemented strategies
+        raise AttributeError(
+            f"hypothesis fallback shim has no strategy {name!r} — extend "
+            "tests/_hypothesis_fallback.py or install hypothesis"
+        )
+
+
+st = _St()

@@ -32,6 +32,11 @@ os.environ.setdefault(
 # set before any txflow_tpu module constructs a lock). Opt out of the
 # audit by exporting TXFLOW_LOCK_AUDIT=0 explicitly.
 os.environ.setdefault("TXFLOW_LOCK_AUDIT", "1")
+# Lockset race auditing (analysis/racegraph.py) rides on the lock audit:
+# every declared shared field's accesses are checked Eraser-style across
+# the whole suite, and the sessionfinish gate below fails the run on any
+# race report. Opt out with TXFLOW_RACE_AUDIT=0.
+os.environ.setdefault("TXFLOW_RACE_AUDIT", "1")
 
 import jax
 
@@ -113,8 +118,54 @@ def _lock_audit_gate(session):
         session.exitstatus = 1
 
 
+def _race_audit_gate(session):
+    """Fail the RUN on any lockset race report, and dump the full field
+    summary to .race_audit.json (repo root) for `tools/lint.py
+    --race-report` — mirrors the lock-audit gate above."""
+    if os.environ.get("TXFLOW_RACE_AUDIT") != "1":
+        return
+    if os.environ.get("TXFLOW_LOCK_AUDIT") != "1":
+        return  # locksets come from the lock audit; nothing was recorded
+    import json
+
+    from txflow_tpu.analysis.racegraph import default_race_auditor
+
+    report = default_race_auditor().report()
+    out = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".race_audit.json",
+    )
+    try:
+        with open(out, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+    except OSError:
+        pass
+    races = report["races"]
+    if not races:
+        return
+    lines = ["runtime race audit: lockset violations observed during the suite:"]
+    for r in races:
+        lines.append(
+            f"  {r['field']}: unlocked {r['access']} at {r['site']} "
+            f"(thread {r['thread']}) races {r['other_site']} "
+            f"(thread {r['other_thread']})"
+        )
+        if r.get("stack"):
+            lines.append(f"    at: {r['stack']}")
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+    if tr is not None:
+        tr.section("runtime race audit", sep="=")
+        for line in lines:
+            tr.write_line(line)
+    else:
+        print("\n".join(lines))
+    if session.exitstatus == 0:
+        session.exitstatus = 1
+
+
 def pytest_sessionfinish(session, exitstatus):
     _lock_audit_gate(session)
+    _race_audit_gate(session)
     offenders = sorted(
         (
             (dur, nodeid)

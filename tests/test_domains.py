@@ -1,0 +1,114 @@
+"""PRNG domain registry (utils.domains): byte-identity pins.
+
+The registry centralizes every domain-separation tag; migrating the use
+sites onto it was required to be a ZERO behavior change — the same
+(seed, inputs) must derive the exact streams shipped before the
+migration. These tests pin each derivation against digests computed
+inline from the HISTORICAL byte layouts, so a registry edit (or a
+refactor of a use site's suffix packing) that would fork a seeded
+schedule fails here, not months later as a quorum mismatch.
+"""
+
+import hashlib
+import random
+
+import pytest
+
+from txflow_tpu.utils.domains import (
+    COMMITTEE_V1,
+    FAULTPLAN_LINK,
+    NETEM_LINK,
+    SCENARIO_AXIS,
+    _register,
+    registered_domains,
+)
+
+# ---------------------------------------------------------------------------
+# registry mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_tag_bytes_are_the_historical_literals():
+    # the exact bytes that prefixed each stream BEFORE the registry
+    # existed; changing any of these forks every schedule it seeds
+    assert COMMITTEE_V1 == b"txflow/committee/v1"
+    assert SCENARIO_AXIS == b"scenario"
+    assert FAULTPLAN_LINK == b"faultplan"
+    assert NETEM_LINK == b"netem"
+
+
+def test_registered_domains_snapshot():
+    doms = registered_domains()
+    assert doms["committee-sampler"] == COMMITTEE_V1
+    assert doms["scenario-axis"] == SCENARIO_AXIS
+    assert doms["faultplan-link"] == FAULTPLAN_LINK
+    assert doms["netem-link"] == NETEM_LINK
+    assert len(set(doms.values())) == len(doms), "tags must be pairwise distinct"
+    # a snapshot, not the live table
+    doms["committee-sampler"] = b"mutated"
+    assert registered_domains()["committee-sampler"] == COMMITTEE_V1
+
+
+def test_register_rejects_duplicate_name_and_tag():
+    with pytest.raises(ValueError, match="duplicate domain name"):
+        _register("committee-sampler", b"totally-new-tag")
+    with pytest.raises(ValueError, match="already registered"):
+        _register("totally-new-name", b"scenario")
+    # neither failed attempt leaked into the table
+    assert "totally-new-name" not in registered_domains()
+    assert registered_domains()["committee-sampler"] == COMMITTEE_V1
+
+
+# ---------------------------------------------------------------------------
+# use-site byte identity (the zero-behavior-change acceptance)
+# ---------------------------------------------------------------------------
+
+
+def test_committee_seed_unchanged():
+    from txflow_tpu.committee.sampler import SEED_DOMAIN, committee_seed
+
+    assert SEED_DOMAIN is COMMITTEE_V1  # re-export intact
+    h = hashlib.sha256()
+    h.update(b"txflow/committee/v1")
+    h.update(b"|")
+    h.update(b"chain-x")
+    h.update(b"|")
+    h.update((5).to_bytes(8, "big"))
+    assert committee_seed("chain-x", 5) == h.digest()
+
+
+def test_axis_seed_unchanged():
+    from txflow_tpu.scenario.spec import axis_rng, axis_seed
+
+    digest = hashlib.sha256(b"scenario|3|weather|wan").digest()
+    want = int.from_bytes(digest[:8], "little")
+    assert axis_seed(3, "weather", "wan") == want
+    assert axis_rng(3, "weather", "wan").random() == random.Random(want).random()
+
+
+def test_faultplan_link_stream_unchanged():
+    from txflow_tpu.faults.plan import FaultPlan, FaultSpec
+
+    plan = FaultPlan(FaultSpec(seed=7))
+    digest = hashlib.sha256(b"faultplan|7|n0|n1").digest()
+    want = random.Random(int.from_bytes(digest[:8], "little"))
+    got = plan._link_rng("n0", "n1")
+    assert [got.random() for _ in range(4)] == [want.random() for _ in range(4)]
+    # per-link cache: same stream object on re-lookup
+    assert plan._link_rng("n0", "n1") is got
+
+
+def test_netem_link_stream_unchanged_and_disjoint_from_faultplan():
+    from txflow_tpu.netem.shaper import LinkShaper
+
+    shaper = LinkShaper(profile="lan", seed=7)
+    digest = hashlib.sha256(b"netem|7|n0|n1").digest()
+    # netem historically packed its seed int big-endian (faultplan is
+    # little-endian) — part of the layout the migration must not touch
+    want = random.Random(int.from_bytes(digest[:8], "big"))
+    got = shaper._link_rng("n0", "n1")
+    assert [got.random() for _ in range(4)] == [want.random() for _ in range(4)]
+    # same (seed, link) under the OTHER domain is a different stream:
+    # the shaper never consumes or perturbs chaos draws
+    fp = hashlib.sha256(b"faultplan|7|n0|n1").digest()
+    assert digest[:8] != fp[:8]

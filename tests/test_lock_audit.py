@@ -348,6 +348,162 @@ def test_inline_commit_effects_run_with_engine_lock_released():
     ) is not None
 
 
+# ---------------------------------------------------------------------------
+# cycle detection over the newer cross-thread components: the StagingRing
+# readback daemon, the host-prep pools' caller-steals path, and the sync
+# manager's advert map. These drive REAL interleavings under the default
+# auditor (the one conftest's sessionfinish gate checks) and then assert
+# the component's locks sit in no cycle and no blocking violation — so a
+# lock added to one of these paths in the wrong order fails HERE, naming
+# the component, instead of only in the end-of-suite gate.
+# ---------------------------------------------------------------------------
+
+
+def _require_default_audit():
+    import os
+
+    if os.environ.get("TXFLOW_LOCK_AUDIT") != "1":
+        pytest.skip("suite running with the lock audit disabled")
+
+
+def _assert_locks_clean(names: set):
+    from txflow_tpu.analysis.lockgraph import default_auditor
+
+    aud = default_auditor()
+    for cyc in aud.cycles():
+        assert not (set(cyc) & names), f"lock cycle through {names}: {cyc}"
+    for v in aud.blocking_violations():
+        assert not (set(v["held"]) & names), v
+
+
+def test_staging_ring_daemon_and_submitters_lock_order():
+    _require_default_audit()
+    import numpy as np
+
+    from txflow_tpu.parallel.staging import StagingRing
+
+    ring = StagingRing(depth=2, name="audit-ring")
+    errs: list = []
+
+    def churn():
+        try:
+            for i in range(15):
+                # depth=2 with eager result(): exercises both the queued
+                # path (daemon holds the slot) and the sync fallback
+                slot = ring.submit(np.full(16, i))
+                assert int(ring.result(slot)[0]) == i
+                ring.stats()  # nests _stats_mtx -> _q_mtx: pins the order
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(e)
+
+    threads = [threading.Thread(target=churn, daemon=True) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not errs, errs
+    ring.close()
+    assert ring.stats()["in_flight"] == 0
+    assert ring.stats()["slots_total"] == 45
+    _assert_locks_clean(
+        {"parallel.StagingRing._q_mtx", "parallel.StagingRing._stats_mtx"}
+    )
+
+
+def test_hostprep_caller_steals_shared_pool_lock_order():
+    # three engines sharing one pool, each stealing queued shards off the
+    # common queue: the F5 fix folds per-call steal tallies in under
+    # _stats_mtx, and this pins that the fold introduces no lock edge
+    _require_default_audit()
+    from txflow_tpu.engine.hostprep import HostPrepPool
+
+    pool = HostPrepPool(workers=4, name="audit-prep")
+    errs: list = []
+
+    def caller():
+        try:
+            for _ in range(8):
+                results, _ = pool.map_shards(48, lambda lo, hi: (lo, hi))
+                assert results == pool.shard_bounds(48)
+                pool.stats()
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(e)
+
+    threads = [threading.Thread(target=caller, daemon=True) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not errs, errs
+    try:
+        # every shard of every call accounted exactly once, no lost
+        # increments across the 3 concurrent callers
+        assert pool.stats()["jobs_total"] == 3 * 8 * 4
+    finally:
+        pool.close()
+    _assert_locks_clean({"engine.HostPrepPool._stats_mtx"})
+
+
+def test_proc_pool_delegation_keeps_thread_pool_lock_order():
+    # ProcHostPrepPool delegates generic map_shards to its embedded
+    # thread pool; the proc pool's own stats lock must stay disjoint
+    # from the inner pool's on that path
+    _require_default_audit()
+    from txflow_tpu.engine.hostprep import make_host_pool
+
+    pool = make_host_pool(3, backend="process", name="audit-procprep")
+    try:
+        results, _ = pool.map_shards(30, lambda lo, hi: hi - lo)
+        assert sum(results) == 30
+        pool.stats()
+    finally:
+        pool.close()
+    _assert_locks_clean(
+        {
+            "engine.ProcHostPrepPool._stats_mtx",
+            "engine.HostPrepPool._stats_mtx",
+        }
+    )
+
+
+def test_sync_manager_advert_threads_lock_order():
+    # gossip recv threads write adverts while the chooser reads them
+    # through lag()/_servable_adverts(); all under sync.SyncManager._mtx
+    _require_default_audit()
+    from txflow_tpu.sync.manager import SyncManager
+
+    sm = SyncManager("audit-chain", TxStore(MemDB()), txflow=None, switch=None)
+    stop = threading.Event()
+    errs: list = []
+
+    def recv(peer: str):
+        try:
+            i = 0
+            while not stop.is_set():
+                sm.note_status(peer, i, i)
+                if i % 7 == 6:
+                    sm.note_peer_gone(peer)
+                i += 1
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=recv, args=(f"peer-{k}",), daemon=True)
+        for k in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for _ in range(200):
+        sm.lag()
+        sm._servable_adverts()
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not errs, errs
+    assert set(sm._servable_adverts()) <= {"peer-0", "peer-1", "peer-2"}
+    _assert_locks_clean({"sync.SyncManager._mtx"})
+
+
 def test_inline_commit_decision_semantics_unchanged():
     # same decisions as before the split: commit exactly at quorum, dedup
     # late votes, purge quorum votes from the pool

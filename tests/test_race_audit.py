@@ -1,0 +1,371 @@
+"""Lockset race auditor (analysis.racegraph) + regression tests for the
+real findings its rollout surfaced and this change fixed:
+
+- F3: ``DeviceVoteVerifier.shapes_used`` was a plain ``set`` mutated by
+  the engine thread (``add`` on dispatch) and the BackgroundWarmer
+  thread (``discard``/``in``/snapshot) with no lock — the old
+  ``_copy_shape_set`` RuntimeError retry loop papered over concurrent
+  resizes. Now ``_ShapeSet``: a ``set`` subclass whose mutators and
+  membership take a leaf lock, with a ``snapshot()`` for consistent
+  copies.
+- F4: ``ByzantineLedger.committee_rescale`` wrote ``_committee_frac``
+  under ``_mtx`` but then computed the effective thresholds OUTSIDE the
+  lock, racing the gossip threads' ``_judge_locked`` reads. Thresholds
+  are now derived under the lock (``_eff_thresholds_locked``).
+- F5: ``HostPrepPool.map_shards`` incremented ``steals_total`` outside
+  ``_stats_mtx`` in the caller-steals loop — concurrent callers lost
+  increments. Steals are now tallied locally and folded in under the
+  stats lock.
+
+Auditor tests use PRIVATE RaceAuditor/LockAuditor instances so synthetic
+races never pollute the default auditors that tests/conftest.py gates the
+whole suite on.
+"""
+
+import threading
+
+import pytest
+
+from txflow_tpu.analysis.lockgraph import AuditedLock, LockAuditor
+from txflow_tpu.analysis import racegraph
+from txflow_tpu.analysis.racegraph import NULL_FIELD, RaceAuditor, shared_field
+from txflow_tpu.engine.hostprep import HostPrepPool
+from txflow_tpu.engine.shapes import _copy_shape_set
+from txflow_tpu.health.byzantine import ByzantineConfig, ByzantineLedger
+from txflow_tpu.verifier import _ShapeSet
+
+# ---------------------------------------------------------------------------
+# auditor mechanics (Eraser state machine)
+# ---------------------------------------------------------------------------
+
+
+def _make():
+    la = LockAuditor()
+    aud = RaceAuditor(lock_auditor=la)
+    return la, aud
+
+
+def _on_thread(fn):
+    exc = []
+
+    def _wrap():
+        try:
+            fn()
+        except BaseException as e:  # pragma: no cover - surfaced below
+            exc.append(e)
+
+    t = threading.Thread(target=_wrap)
+    t.start()
+    t.join()
+    if exc:
+        raise exc[0]
+
+
+def test_consistent_lockset_is_clean():
+    la, aud = _make()
+    lk = AuditedLock("L", auditor=la)
+    f = aud.declare("x")
+    with lk:
+        f.note_write()
+
+    def locked_write():
+        with lk:
+            f.note_write()
+
+    _on_thread(locked_write)
+    with lk:
+        f.note_write()
+    assert aud.races() == []
+    snap = aud.report()["fields"]["x"]
+    assert snap["lockset"] == ["L"]
+    assert snap["max_threads"] == 2
+    assert snap["racy"] == 0
+
+
+def test_empty_lockset_two_threads_reports_once():
+    la, aud = _make()
+    lk = AuditedLock("L", auditor=la)
+    f = aud.declare("x")
+    with lk:
+        f.note_write()  # EXCLUSIVE(main)
+
+    def unlocked():
+        for _ in range(5):
+            f.note_write()  # same racy site every lap: deduped to one
+
+    _on_thread(unlocked)
+    races = aud.races()
+    assert len(races) == 1
+    r = races[0]
+    assert r["field"] == "x"
+    assert r["access"] == "write"
+    assert "test_race_audit.py" in r["site"]
+    assert aud.report()["fields"]["x"]["racy"] == 1
+
+
+def test_read_only_sharing_is_benign_until_write():
+    la, aud = _make()
+    f = aud.declare("x")
+    f.note_write()  # EXCLUSIVE(main)
+    _on_thread(f.note_read)  # SHARED: refine but never report
+    assert aud.races() == []
+    _on_thread(f.note_write)  # write while shared, empty lockset: report
+    assert len(aud.races()) == 1
+
+
+def test_disjoint_locksets_intersect_to_empty():
+    la, aud = _make()
+    a = AuditedLock("A", auditor=la)
+    b = AuditedLock("B", auditor=la)
+    f = aud.declare("x")
+    with a:
+        f.note_write()
+
+    def under_b():
+        with b:
+            f.note_write()  # candidate {B}
+
+    def under_a():
+        with a:
+            f.note_write()  # {B} & {A} = {} -> report
+
+    _on_thread(under_b)
+    assert aud.races() == []
+    _on_thread(under_a)
+    assert len(aud.races()) == 1
+
+
+def test_handoff_transfers_ownership():
+    la, aud = _make()
+    f = aud.declare("slot")
+    f.note_write()  # owner: main
+    f.handoff("queue hand-over to the worker")
+    _on_thread(f.note_write)  # new exclusive owner, no report
+    assert aud.races() == []
+    assert aud.report()["fields"]["slot"]["handoffs"] == 1
+
+
+def test_handoff_requires_justification():
+    la, aud = _make()
+    f = aud.declare("slot")
+    with pytest.raises(AssertionError):
+        f.handoff("")
+
+
+def test_report_schema_and_reset():
+    la, aud = _make()
+    f = aud.declare("x")
+    f.note_write()
+    _on_thread(f.note_write)
+    rep = aud.report()
+    assert set(rep) == {"fields", "races"}
+    s = rep["fields"]["x"]
+    assert set(s) == {
+        "fields", "reads", "writes", "handoffs", "max_threads",
+        "lockset", "racy",
+    }
+    assert s["writes"] == 2
+    aud.reset()
+    assert aud.races() == []
+    assert aud.report()["fields"]["x"]["racy"] == 0
+
+
+def test_shared_field_is_noop_when_disabled(monkeypatch):
+    monkeypatch.setenv("TXFLOW_RACE_AUDIT", "0")
+    f = shared_field("anything")
+    assert f is NULL_FIELD
+    f.note_read()
+    f.note_write()
+    f.handoff("no-op")
+
+
+def test_shared_field_requires_lock_audit(monkeypatch):
+    # locksets come from lockgraph's held-stack: race audit without the
+    # lock audit would see every lockset empty and cry wolf everywhere
+    monkeypatch.setenv("TXFLOW_RACE_AUDIT", "1")
+    monkeypatch.setenv("TXFLOW_LOCK_AUDIT", "0")
+    assert shared_field("anything") is NULL_FIELD
+
+
+# ---------------------------------------------------------------------------
+# F3 regression: shapes_used is lock-guarded and still set-shaped
+# ---------------------------------------------------------------------------
+
+
+def test_shape_set_is_a_set_and_snapshot_consistent():
+    s = _ShapeSet("test.shapes_used")
+    s.add(("verify", 64, 64))
+    s.add(("fused", 256, 64))
+    s.discard(("verify", 64, 64))
+    assert ("fused", 256, 64) in s
+    assert ("verify", 64, 64) not in s
+    # reader idiom the warm registry and the drills rely on
+    assert set(s) == {("fused", 256, 64)}
+    assert s.snapshot() == {("fused", 256, 64)}
+    assert _copy_shape_set(s) == {("fused", 256, 64)}
+
+
+def test_shape_set_concurrent_mutation_never_tears():
+    s = _ShapeSet("test.shapes_used.stress")
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        try:
+            i = 0
+            while not stop.is_set():
+                shape = ("verify", i % 64, 64)
+                s.add(shape)
+                s.discard(shape)
+                i += 1
+        except BaseException as e:  # pragma: no cover
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                s.snapshot()
+                ("verify", 1, 64) in s  # noqa: B015 - exercising __contains__
+                _copy_shape_set(s)
+        except BaseException as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(2)] + [
+        threading.Thread(target=reader) for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    stop_timer = threading.Timer(0.5, stop.set)
+    stop_timer.start()
+    for t in threads:
+        t.join()
+    stop_timer.cancel()
+    assert errors == []
+
+
+# ---------------------------------------------------------------------------
+# F4 regression: committee thresholds derived under the ledger lock
+# ---------------------------------------------------------------------------
+
+
+def test_committee_rescale_values_and_restore():
+    led = ByzantineLedger(ByzantineConfig())
+    # defaults: min_samples=32, max_bad_rate=0.5
+    assert led.committee_rescale(0.25) == (8, 0.2)  # both floors engage
+    assert led.committee_rescale(0.5) == (16, 0.25)
+    assert led.committee_rescale(1.0) == (32, 0.5)
+    assert led.committee_rescale(2.0) == (32, 0.5)  # clamped to full-set
+
+
+def test_committee_rescale_concurrent_with_judging():
+    led = ByzantineLedger(ByzantineConfig(min_samples=8, window=64))
+    errors = []
+    stop = threading.Event()
+
+    def rescaler():
+        try:
+            f = 0.1
+            while not stop.is_set():
+                led.committee_rescale(f)
+                f = 1.0 if f < 0.5 else 0.1
+        except BaseException as e:  # pragma: no cover
+            errors.append(e)
+
+    def judge():
+        try:
+            i = 0
+            while not stop.is_set():
+                led.note_frame(f"peer-{i % 4}", kept=3,
+                               drops={"stale_height": 1})
+                i += 1
+        except BaseException as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=rescaler)] + [
+        threading.Thread(target=judge) for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    timer = threading.Timer(0.5, stop.set)
+    timer.start()
+    for t in threads:
+        t.join()
+    timer.cancel()
+    assert errors == []
+    snap = led.snapshot()
+    assert set(snap["breaker"]) == {"min_samples", "max_bad_rate"}
+
+
+# ---------------------------------------------------------------------------
+# F5 regression: caller-steal accounting folds in under the stats lock
+# ---------------------------------------------------------------------------
+
+
+class _NoWorkerPool(HostPrepPool):
+    """Workers exit immediately: the CALLER must steal every queued
+    shard — deterministic steal counts for the accounting regression."""
+
+    def _worker(self):
+        return
+
+
+def test_steal_accounting_exact_when_serial():
+    pool = _NoWorkerPool(workers=4)
+    try:
+        results, _wait = pool.map_shards(8, lambda lo, hi: (lo, hi))
+        assert results == [(0, 2), (2, 4), (4, 6), (6, 8)]
+        st = pool.stats()
+        assert st["jobs_total"] == 4
+        # all three non-inline shards were stolen by the caller — every
+        # steal must be counted
+        assert st["steals_total"] == 3
+    finally:
+        pool.close()
+
+
+def test_concurrent_map_shards_jobs_total_exact():
+    pool = HostPrepPool(workers=2)
+    calls = 16
+    try:
+        def caller():
+            for _ in range(calls):
+                pool.map_shards(4, lambda lo, hi: hi - lo)
+
+        threads = [threading.Thread(target=caller) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        st = pool.stats()
+        assert st["jobs_total"] == 4 * calls * 2  # 2 shards per call
+        assert st["steals_total"] >= 0
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a lock-disciplined pool holds its lockset under the
+# DEFAULT auditor (the one the conftest gate reads)
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_log_field_holds_lockset_under_default_auditor():
+    if not racegraph.audit_enabled():
+        pytest.skip("race audit disarmed (TXFLOW_RACE_AUDIT != 1)")
+    from txflow_tpu.pool.base import IngestLogPool
+
+    class _DrillPool(IngestLogPool):
+        def add(self, key: bytes) -> None:
+            with self._mtx:
+                self._items[key] = key
+                self._log_append(key)
+
+    pool = _DrillPool()
+    pool.add(b"a")
+    _on_thread(lambda: pool.add(b"b"))
+    out, _pos = pool._entries_from(0, 10)
+    assert [k for k, _ in out] == [b"a", b"b"]
+    summary = racegraph.default_race_auditor().report()["fields"]
+    s = summary["pool._DrillPool.ingest_log"]
+    assert s["racy"] == 0
+    assert s["lockset"] == ["pool._DrillPool._mtx"]

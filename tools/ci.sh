@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# CI entry point: static analysis first, then the tier-1 suite.
+#
+# The txlint gate costs ~2 s and catches the whole class of invariant
+# breaks (hot-loop syncs, recompile hazards, lock discipline, stale
+# suppressions) that would otherwise burn a full pytest run — or worse,
+# pass it — before a human notices. Its exit codes: 1 = unsuppressed
+# violations, 2 = files that failed to parse.
+#
+# The pytest invocation is the ROADMAP.md tier-1 verify command,
+# verbatim — keep the two in lockstep (the DOTS_PASSED line is what the
+# driver greps for).
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== txlint --check =="
+python tools/lint.py --check || exit $?
+
+echo "== tier-1 pytest =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+  2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

@@ -28,6 +28,7 @@ is O(1) cache/counter work.
 from __future__ import annotations
 
 from ..analysis.lockgraph import make_lock
+from ..analysis.racegraph import shared_field
 from ..pool.mempool import LANE_BULK, LANE_PRIORITY
 from ..trace.tracer import NULL_TRACER, SPAN_ADMISSION
 from ..utils.cache import make_lru
@@ -67,6 +68,9 @@ class AdmissionController:
         # (make_lru returns the owner-serialized cache on GIL builds; this
         # lock IS that owner)
         self._mtx = make_lock("admission.AdmissionController._mtx")
+        # dedup LRU + overload verdict + rate buckets: RPC handler
+        # threads and gossip receive threads admit concurrently
+        self._sh_state = shared_field("admission.AdmissionController.state")  # txlint: shared(self._mtx)
         self.dedup = make_lru(self.cfg.dedup_size)
         self._overloaded = False
         self._next_poll = 0.0  # monotonic deadline of the cached verdict
@@ -119,12 +123,14 @@ class AdmissionController:
         if now is None:
             now = monotonic()
         with self._mtx:
+            self._sh_state.note_write()
             if now < self._next_poll:
                 return self._overloaded
             self._next_poll = now + self.cfg.pressure_interval
         self._sample_commit_rate(now)
         occ = self.mempool.size() / max(1, self.mempool.config.size)
         with self._mtx:
+            self._sh_state.note_write()
             if self._overloaded:
                 if occ <= self.cfg.low_water_frac:
                     self._overloaded = False
@@ -152,6 +158,7 @@ class AdmissionController:
             return  # a faulting source must not error the admit path
         cfg = self.cfg
         with self._mtx:
+            self._sh_state.note_write()
             if self._cr_count is None or self._cr_t is None:
                 self._cr_count, self._cr_t = count, now
                 return
@@ -191,6 +198,7 @@ class AdmissionController:
             now = monotonic()
         cap = max(self.cfg.bulk_burst, rate, 1.0)
         with self._mtx:
+            self._sh_state.note_write()
             if self._bulk_refill_t is not None and now > self._bulk_refill_t:
                 self._bulk_tokens = min(
                     cap, self._bulk_tokens + (now - self._bulk_refill_t) * rate
@@ -236,6 +244,7 @@ class AdmissionController:
         if not self.cfg.enabled:
             return self.lane_of(tx)
         with self._mtx:
+            self._sh_state.note_read()
             dup = key in self.dedup
         if dup:
             self.metrics.rejected_dup.add(1)
@@ -256,6 +265,7 @@ class AdmissionController:
             self.metrics.rejected_overload.add(1)
             raise ErrOverloaded(self.cfg.retry_after)
         with self._mtx:
+            self._sh_state.note_write()
             self.dedup.push(key)
         if lane == LANE_PRIORITY:
             self.metrics.admitted_priority.add(1)
@@ -269,6 +279,7 @@ class AdmissionController:
         """Roll an admit_rpc reservation back (mempool rejected the tx
         for a non-dup reason) so the client's retry isn't dup-bounced."""
         with self._mtx:
+            self._sh_state.note_write()
             self.dedup.remove(key)
 
     def _priority_sender_exceeded(
@@ -285,6 +296,7 @@ class AdmissionController:
             now = monotonic()
         cap = max(self.cfg.priority_sender_burst, rate, 1.0)
         with self._mtx:
+            self._sh_state.note_write()
             b = self._sender_buckets.get(sender)
             if b is None:
                 if len(self._sender_buckets) >= max(1, self.cfg.priority_sender_max):
@@ -318,6 +330,7 @@ class AdmissionController:
             now = monotonic()
         cap = max(self.cfg.peer_burst, rate, 1.0)
         with self._mtx:
+            self._sh_state.note_write()
             b = self._peer_buckets.get(peer_id)
             if b is None:
                 if len(self._peer_buckets) >= max(1, self.cfg.peer_max):

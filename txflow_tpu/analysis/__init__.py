@@ -9,10 +9,17 @@ engine loops, lock-free LRU construction routed through the one factory
 that owns the GIL assumption, and hand-synced twin code paths pinned to
 their parity tests.
 
-Runtime side (``lockgraph``): an opt-in audited lock wrapper
-(``TXFLOW_LOCK_AUDIT=1``) that records the cross-thread lock acquisition
-graph, flags ordering cycles (potential deadlocks) and blocking calls made
-while holding a lock.
+Runtime side, two auditors:
+
+- ``lockgraph``: an opt-in audited lock wrapper (``TXFLOW_LOCK_AUDIT=1``)
+  that records the cross-thread lock acquisition graph, flags ordering
+  cycles (potential deadlocks) and blocking calls made while holding a
+  lock.
+- ``racegraph``: Eraser-style lockset race auditing
+  (``TXFLOW_RACE_AUDIT=1``, rides on lockgraph's held-lock tracking) over
+  fields declared shared-mutable via ``shared_field`` + the
+  ``# txlint: shared(lock)`` intent annotation, with a sanctioned
+  ``handoff()`` API for ownership-transfer protocols.
 
 Import surface is deliberately split: ``lockgraph`` is imported by hot
 runtime modules (engine/pools/p2p) and stays dependency-light; the AST
@@ -27,4 +34,11 @@ from .lockgraph import (  # noqa: F401
     make_rlock,
     note_blocking,
     sanctioned_blocking,
+)
+from .racegraph import (  # noqa: F401
+    NULL_FIELD,
+    RaceAuditor,
+    SharedField,
+    default_race_auditor,
+    shared_field,
 )

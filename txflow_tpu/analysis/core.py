@@ -33,6 +33,11 @@ RULES = {
     "trace-clock": "raw time.* timestamp source in a traced hot-path module (use the utils.clock seam)",
     "twin-path": "hand-synced twin changed without its registered parity test",
     "bad-suppression": "txlint suppression without a justification or with an unknown rule",
+    "host-sync": "implicit device->host sync in a hot module outside the sanctioned readback seams",
+    "recompile-hazard": "dispatch shape arg does not provably flow from the bucket ladder / warm registry",
+    "seed-domain": "inline PRNG domain literal outside the utils.domains registry (or a duplicate tag)",
+    "shared-decl": "shared_field() without a valid `# txlint: shared(...)` intent annotation (or a dangling one)",
+    "stale-suppression": "txlint allow() comment that no longer suppresses anything",
 }
 
 _ALLOW_RE = re.compile(
@@ -59,6 +64,7 @@ class _Suppression:
     line: int
     rules: set[str]  # {"*"} = all
     justification: str
+    used: bool = False  # matched at least one flagged (rule, line)
 
     def covers(self, rule: str) -> bool:
         return "*" in self.rules or rule in self.rules
@@ -74,7 +80,10 @@ class ModuleSource:
         self.tree = ast.parse(text, filename=path)
         self.suppressions: list[_Suppression] = []
         self.suppression_errors: list[Violation] = []
+        doc_lines = _docstring_lines(self.tree)
         for i, line in enumerate(self.lines, 1):
+            if i in doc_lines:
+                continue  # a docstring EXAMPLE must never suppress (or go stale)
             m = _ALLOW_RE.search(line)
             if m is None:
                 continue
@@ -108,11 +117,47 @@ class ModuleSource:
         end = min(end_lineno or lineno, lineno + 4)
         for s in self.suppressions:
             if lineno <= s.line <= end and s.covers(rule):
+                s.used = True
                 return s
         return None
 
+    def stale_suppressions(self) -> list[Violation]:
+        """allow() comments that matched nothing this run — dead weight
+        that silently blankets whatever lands on that line next. Only
+        meaningful after the FULL default pass set ran."""
+        return [
+            Violation(
+                "stale-suppression", self.path, s.line,
+                f"allow({', '.join(sorted(s.rules))}) suppresses nothing — "
+                "the flagged code moved or was fixed; delete the comment "
+                "(tools/lint.py --prune-suppressions)",
+            )
+            for s in self.suppressions
+            if not s.used
+        ]
+
     def line_suppressed(self, rule: str, lineno: int) -> bool:
         return self.suppression_for(rule, lineno) is not None
+
+
+def _docstring_lines(tree: ast.AST) -> set[int]:
+    """Physical lines covered by module/class/function docstrings."""
+    out: set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(
+            node, (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            continue
+        body = getattr(node, "body", [])
+        if (
+            body
+            and isinstance(body[0], ast.Expr)
+            and isinstance(body[0].value, ast.Constant)
+            and isinstance(body[0].value.value, str)
+        ):
+            doc = body[0].value
+            out.update(range(doc.lineno, (doc.end_lineno or doc.lineno) + 1))
+    return out
 
 
 class LintPass:
@@ -139,6 +184,10 @@ def default_passes() -> list[LintPass]:
         _p.HotPathPass(),
         _p.UnlockedLRUPass(),
         _p.TraceClockPass(),
+        _p.HostSyncPass(),
+        _p.RecompileHazardPass(),
+        _p.SeedDomainPass(),
+        _p.SharedDeclPass(),
         TwinPathPass(),
     ]
 
@@ -157,6 +206,9 @@ def lint_tree(
     {"violations": [...active...], "suppressed": [...], "errors": [...],
     "files_scanned": n}."""
     repo_root = Path(repo_root)
+    # stale-suppression only means something when every pass that could
+    # consume a suppression actually ran
+    check_stale = lint_passes is None
     lint_passes = lint_passes if lint_passes is not None else default_passes()
     active: list[Violation] = []
     suppressed: list[Violation] = []
@@ -180,6 +232,8 @@ def lint_tree(
                     suppressed.append(v)
                 else:
                     active.append(v)
+        if check_stale:
+            active.extend(module.stale_suppressions())
     for p in lint_passes:
         active.extend(p.finalize(repo_root))
     active.sort(key=lambda v: (v.path, v.line, v.rule))
@@ -199,6 +253,7 @@ def lint_source(
     passes key off the path). Fixture-test entry point. Returns
     (active, suppressed)."""
     module = ModuleSource(virtual_path, text)
+    check_stale = lint_passes is None
     lint_passes = lint_passes if lint_passes is not None else default_passes()
     active: list[Violation] = list(module.suppression_errors)
     suppressed: list[Violation] = []
@@ -211,6 +266,8 @@ def lint_source(
                 suppressed.append(v)
             else:
                 active.append(v)
+    if check_stale:
+        active.extend(module.stale_suppressions())
     return active, suppressed
 
 

@@ -655,3 +655,445 @@ class UnlockedLRUPass(LintPass):
                         )
                     )
         return out
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------------
+
+# Module-wide implicit device->host sync hunt: hotpath-sync pins the
+# enumerated engine-loop functions; this pass covers the REST of the hot
+# modules, where a float()/int()/np.asarray on a device value is just as
+# much a stall — it only hides better because the function isn't on the
+# pipelined loop (yet). Device provenance is tracked per function:
+# results of jnp.* expressions, calls to *_jit/*_fused/*_kernel names,
+# and the verifier's jitted `self._fn` dispatch.
+_HOSTSYNC_SCOPE = (
+    "txflow_tpu/engine/",
+    "txflow_tpu/ops/",
+    "txflow_tpu/parallel/",
+    "txflow_tpu/committee/",
+    "txflow_tpu/verifier.py",
+)
+
+# sanctioned readback seams: the named functions EXIST to be the one
+# blocking transfer on their path (COMPONENTS.md "Verify pipeline")
+_HOSTSYNC_SEAMS = {
+    # the staging ring's dedicated readback thread
+    ("txflow_tpu/parallel/staging.py", "_run"),
+    # the verifier's single ring-aware blocking readback
+    ("txflow_tpu/verifier.py", "_force_readback"),
+    # convenience host API: prepared batch in, bool[B] out, by contract
+    ("txflow_tpu/ops/ed25519_batch.py", "verify_batch"),
+    # certificate tally: ONE fused device call, one readback, batched
+    ("txflow_tpu/committee/certverify.py", "verify_and_tally"),
+}
+
+_DEVICE_ROOTS = {"jnp"}
+_DEVICE_FN_SUFFIXES = ("_jit", "_fused", "_kernel")
+_DEVICE_ATTRS = {"_fn"}  # the verifier's jitted dispatch callable
+
+
+def _device_producer_call(call: ast.Call) -> bool:
+    f = call.func
+    while isinstance(f, ast.Call):  # _kernel()(...) — unwrap to the maker
+        f = f.func
+    name = _expr_str(f) if isinstance(f, (ast.Attribute, ast.Name)) else ""
+    if not name:
+        return False
+    root = name.split(".", 1)[0]
+    last = name.rsplit(".", 1)[-1]
+    if root in _DEVICE_ROOTS or name.startswith("jax.numpy."):
+        return True
+    return last.endswith(_DEVICE_FN_SUFFIXES) or last in _DEVICE_ATTRS
+
+
+def _device_flavored(node: ast.AST, tainted: set[str]) -> bool:
+    """True when the expression's value plausibly lives on device."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+        if isinstance(sub, ast.Attribute):
+            expr = _expr_str(sub)
+            if expr.split(".", 1)[0] in _DEVICE_ROOTS or expr.startswith(
+                "jax.numpy."
+            ):
+                return True
+        if isinstance(sub, ast.Call) and _device_producer_call(sub):
+            return True
+    return False
+
+
+class HostSyncPass(LintPass):
+    """Implicit host syncs on device values in hot modules, outside the
+    sanctioned StagingRing/readback seams.
+
+    Flags, per function: ``.item()`` / ``.block_until_ready()`` /
+    ``jax.device_get`` unconditionally, and ``float(x)`` / ``int(x)`` /
+    ``np.asarray(x)`` when ``x`` is device-flavored (a jnp expression, a
+    call to a jitted kernel, or a local bound from one)."""
+
+    name = "host-sync"
+
+    def run(self, module: ModuleSource) -> list[Violation]:
+        if not module.path.startswith(_HOSTSYNC_SCOPE):
+            return []
+        hot = _HOT_FUNCS.get(module.path, set())
+        out: list[Violation] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name in hot:
+                continue  # hotpath-sync already pins these, don't double-report
+            if (module.path, node.name) in _HOSTSYNC_SEAMS:
+                continue
+            out.extend(self._check_func(module, node))
+        return out
+
+    def _check_func(self, module: ModuleSource, fn: ast.AST) -> list[Violation]:
+        tainted = self._tainted_names(fn)
+        out: list[Violation] = []
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            if isinstance(f, ast.Attribute):
+                recv = _expr_str(f.value)
+                if f.attr == "item" and not sub.args:
+                    out.append(self._v(module, sub,
+                                       ".item() — per-element device readback"))
+                elif f.attr == "block_until_ready":
+                    out.append(self._v(module, sub,
+                                       ".block_until_ready() — full device sync"))
+                elif f.attr == "device_get":
+                    out.append(self._v(module, sub,
+                                       "device_get — explicit host readback"))
+                elif (
+                    f.attr == "asarray"
+                    and recv.split(".", 1)[0] in ("np", "numpy")
+                    and sub.args
+                    and _device_flavored(sub.args[0], tainted)
+                ):
+                    out.append(self._v(
+                        module, sub,
+                        "np.asarray on a device value — blocking transfer",
+                    ))
+            elif isinstance(f, ast.Name) and f.id in ("float", "int"):
+                if sub.args and _device_flavored(sub.args[0], tainted):
+                    out.append(self._v(
+                        module, sub,
+                        f"{f.id}() on a device value — scalar readback sync",
+                    ))
+        return out
+
+    def _tainted_names(self, fn: ast.AST) -> set[str]:
+        tainted: set[str] = set()
+        for _ in range(4):  # tiny fixpoint: chains of assignments
+            before = len(tainted)
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Assign) and _device_flavored(
+                    sub.value, tainted
+                ):
+                    for tgt in sub.targets:
+                        for t in ast.walk(tgt):
+                            if isinstance(t, ast.Name):
+                                tainted.add(t.id)
+            if len(tainted) == before:
+                break
+        return tainted
+
+    def _v(self, module: ModuleSource, node: ast.AST, why: str) -> Violation:
+        return Violation(
+            self.name, module.path, node.lineno,
+            f"{why}; route through the StagingRing/_force_readback seam "
+            "or move off the hot module",
+        )
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+# ---------------------------------------------------------------------------
+
+# The zero-recompile contract (engine/shapes.py): every compiled shape
+# must come off the bucket ladder or the warm registry. A dispatch-site
+# shape arg that doesn't provably flow from the blessed helpers is a
+# latent recompile — it works until the first unbucketed batch size, then
+# costs a full XLA compile mid-flight.
+_SHAPE_SCOPE = (
+    "txflow_tpu/verifier.py",
+    "txflow_tpu/engine/shapes.py",
+    "txflow_tpu/engine/txflow.py",
+    "txflow_tpu/parallel/mesh.py",
+    "txflow_tpu/committee/certverify.py",
+)
+
+# blessed shape sources: the ladder + prediction helpers
+_SHAPE_FUNCS = {
+    "bucket_size", "_generating_size", "predicted_shapes",
+    "shapes_for_batch", "enumerate_shapes", "_rung",
+}
+
+# blessed shape-carrying attributes (ladder config, not raw input sizes)
+_SHAPE_ATTRS = {"buckets", "miss_buckets", "max_batch", "capacity", "_n_shards"}
+
+
+class RecompileHazardPass(LintPass):
+    """Shape args at dispatch sinks must provably flow from the bucket
+    ladder. Sinks: ``_pad(x, P)``'s pad width and ``shapes_used.add(t)``'s
+    tuple elements. Provenance propagates through assignments, BinOps
+    with a ladder-derived operand (``pad = b - n``), subscripts of
+    blessed attrs (``self.buckets[0]``), min/max, and conditionals."""
+
+    name = "recompile-hazard"
+
+    def run(self, module: ModuleSource) -> list[Violation]:
+        if module.path not in _SHAPE_SCOPE:
+            return []
+        out: list[Violation] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_func(module, node))
+        return out
+
+    def _check_func(self, module: ModuleSource, fn: ast.AST) -> list[Violation]:
+        safe = self._safe_names(fn)
+        out: list[Violation] = []
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            fname = _expr_str(f) if isinstance(f, (ast.Attribute, ast.Name)) else ""
+            last = fname.rsplit(".", 1)[-1]
+            if last == "_pad" and len(sub.args) >= 2:
+                if not self._is_safe(sub.args[1], safe):
+                    out.append(Violation(
+                        self.name, module.path, sub.lineno,
+                        "_pad width does not flow from the bucket ladder "
+                        "(bucket_size/ShapeWarmRegistry) — every new raw "
+                        "size is a fresh XLA compile",
+                    ))
+            elif (
+                last == "add"
+                and isinstance(f, ast.Attribute)
+                and _expr_str(f.value).rsplit(".", 1)[-1] == "shapes_used"
+                and sub.args
+            ):
+                arg = sub.args[0]
+                elts = arg.elts if isinstance(arg, ast.Tuple) else [arg]
+                for e in elts:
+                    if not self._is_safe(e, safe):
+                        out.append(Violation(
+                            self.name, module.path, sub.lineno,
+                            "shapes_used entry element does not flow from "
+                            "the bucket ladder — the warm registry would "
+                            "bank an unreachable (or unbounded) shape",
+                        ))
+                        break
+        return out
+
+    def _safe_names(self, fn: ast.AST) -> set[str]:
+        safe: set[str] = set()
+        for _ in range(6):
+            before = len(safe)
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Assign):
+                    if self._is_safe(sub.value, safe):
+                        for tgt in sub.targets:
+                            for t in ast.walk(tgt):
+                                if isinstance(t, ast.Name):
+                                    safe.add(t.id)
+                elif isinstance(sub, (ast.For,)) and self._is_safe(
+                    sub.iter, safe
+                ):
+                    for t in ast.walk(sub.target):
+                        if isinstance(t, ast.Name):
+                            safe.add(t.id)
+            if len(safe) == before:
+                break
+        return safe
+
+    def _is_safe(self, node: ast.AST, safe: set[str]) -> bool:
+        if isinstance(node, ast.Constant):
+            return isinstance(node.value, (int, str))
+        if isinstance(node, ast.Name):
+            return node.id in safe
+        if isinstance(node, ast.Attribute):
+            return node.attr in _SHAPE_ATTRS
+        if isinstance(node, ast.Subscript):
+            return self._is_safe(node.value, safe)
+        if isinstance(node, ast.UnaryOp):
+            return self._is_safe(node.operand, safe)
+        if isinstance(node, ast.IfExp):
+            return self._is_safe(node.body, safe) and self._is_safe(
+                node.orelse, safe
+            )
+        if isinstance(node, ast.Tuple):
+            return all(self._is_safe(e, safe) for e in node.elts)
+        if isinstance(node, ast.BinOp):
+            # ladder provenance survives arithmetic with raw sizes
+            # (pad = b - n) but a bare-constant operand does not bless
+            # the other side (n + 1 is still a raw size)
+            return self._ladderish(node.left, safe) or self._ladderish(
+                node.right, safe
+            )
+        if isinstance(node, ast.Call):
+            fname = (
+                _expr_str(node.func)
+                if isinstance(node.func, (ast.Attribute, ast.Name))
+                else ""
+            )
+            last = fname.rsplit(".", 1)[-1]
+            if last in _SHAPE_FUNCS:
+                return True
+            if last in ("min", "max"):
+                return any(self._ladderish(a, safe) for a in node.args)
+        return False
+
+    def _ladderish(self, node: ast.AST, safe: set[str]) -> bool:
+        return not isinstance(node, ast.Constant) and self._is_safe(node, safe)
+
+
+# ---------------------------------------------------------------------------
+# seed-domain
+# ---------------------------------------------------------------------------
+
+_DOMAINS_MODULE = "txflow_tpu/utils/domains.py"
+
+
+def _domain_tag_literal(value) -> bool:
+    """A bytes literal that reads as a PRNG domain tag: a pipe-separated
+    domain format (not a bare joiner/suffix starting with '|') or the
+    versioned txflow/ namespace."""
+    if not isinstance(value, bytes):
+        return False
+    if value.startswith(b"txflow/"):
+        return True
+    return b"|" in value and not value.startswith(b"|")
+
+
+class SeedDomainPass(LintPass):
+    """Every PRNG domain tag lives in utils.domains (the ONE registry,
+    duplicate-checked at import): an inline raw domain literal inside a
+    sha256()/update() call can silently collide with a registered stream.
+    The registry itself is also re-checked statically for duplicate
+    literals, so a broken registry fails lint even if never imported."""
+
+    name = "seed-domain"
+
+    def run(self, module: ModuleSource) -> list[Violation]:
+        if module.path == _DOMAINS_MODULE:
+            return self._check_registry(module)
+        out: list[Violation] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            fname = _expr_str(f) if isinstance(f, (ast.Attribute, ast.Name)) else ""
+            last = fname.rsplit(".", 1)[-1]
+            if last not in ("sha256", "update"):
+                continue
+            for arg in node.args:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Constant) and _domain_tag_literal(
+                        sub.value
+                    ):
+                        out.append(Violation(
+                            self.name, module.path, sub.lineno,
+                            f"inline PRNG domain literal {sub.value!r} — "
+                            "register the tag in utils.domains and import "
+                            "it, so collisions fail fast in one place",
+                        ))
+        return out
+
+    def _check_registry(self, module: ModuleSource) -> list[Violation]:
+        out: list[Violation] = []
+        names: dict[str, int] = {}
+        tags: dict[bytes, int] = {}
+        for node in ast.walk(module.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "_register"
+                and len(node.args) == 2
+            ):
+                continue
+            nm, tag = node.args
+            if isinstance(nm, ast.Constant) and isinstance(nm.value, str):
+                if nm.value in names:
+                    out.append(Violation(
+                        self.name, module.path, node.lineno,
+                        f"duplicate domain name {nm.value!r} "
+                        f"(first registered line {names[nm.value]})",
+                    ))
+                else:
+                    names[nm.value] = node.lineno
+            if isinstance(tag, ast.Constant) and isinstance(tag.value, bytes):
+                if tag.value in tags:
+                    out.append(Violation(
+                        self.name, module.path, node.lineno,
+                        f"duplicate domain tag {tag.value!r} "
+                        f"(first registered line {tags[tag.value]})",
+                    ))
+                else:
+                    tags[tag.value] = node.lineno
+        return out
+
+
+# ---------------------------------------------------------------------------
+# shared-decl
+# ---------------------------------------------------------------------------
+
+_SHARED_RE = re.compile(r"#\s*txlint:\s*shared\(([^)]*)\)")
+
+
+class SharedDeclPass(LintPass):
+    """Every ``shared_field(...)`` declaration carries the static intent
+    annotation ``# txlint: shared(<lock>)`` naming the lock that is
+    supposed to guard the field (or ``handoff`` for ownership-transfer
+    protocols) — and every such annotation sits on a real declaration.
+    The runtime race auditor then checks the intent against what threads
+    actually held."""
+
+    name = "shared-decl"
+
+    def run(self, module: ModuleSource) -> list[Violation]:
+        if module.path.startswith("txflow_tpu/analysis/"):
+            return []  # the auditor's own docs spell the annotation
+        annotations: dict[int, str] = {}
+        for i, line in enumerate(module.lines, 1):
+            m = _SHARED_RE.search(line)
+            if m is not None:
+                annotations[i] = m.group(1).strip()
+        out: list[Violation] = []
+        used: set[int] = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            fname = _expr_str(f) if isinstance(f, (ast.Attribute, ast.Name)) else ""
+            if fname.rsplit(".", 1)[-1] != "shared_field":
+                continue
+            span = range(node.lineno, (node.end_lineno or node.lineno) + 1)
+            ann_line = next((i for i in span if i in annotations), None)
+            if ann_line is None:
+                out.append(Violation(
+                    self.name, module.path, node.lineno,
+                    "shared_field() without a `# txlint: shared(<lock>)` "
+                    "annotation naming the guarding lock (or `handoff`)",
+                ))
+                continue
+            used.add(ann_line)
+            expr = annotations[ann_line]
+            if expr != "handoff" and not _is_lockish(expr):
+                out.append(Violation(
+                    self.name, module.path, ann_line,
+                    f"shared({expr}) names neither a lock-like expression "
+                    "nor `handoff`",
+                ))
+        for i in sorted(set(annotations) - used):
+            out.append(Violation(
+                self.name, module.path, i,
+                "dangling `# txlint: shared(...)` annotation — no "
+                "shared_field() declaration on this line",
+            ))
+        return out

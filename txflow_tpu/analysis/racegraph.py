@@ -1,0 +1,330 @@
+"""Runtime lockset race auditor (the Eraser half of txlint's dynamic side).
+
+The lock-order auditor (``lockgraph``) proves the locks we DO take are
+taken in a consistent order; it says nothing about state touched with no
+lock at all. This module closes that gap with per-field lockset
+intersection à la Eraser (Savage et al., SOSP '97 — the lineage behind
+Go's race detector): every *declared* shared-mutable field records, on
+each access, the set of audited locks the accessing thread currently
+holds. The field's *candidate lockset* starts as the first cross-thread
+access's held set and is intersected on every subsequent access; a field
+whose candidate set empties while at least two threads touched it (with
+at least one write) has no lock consistently protecting it — a race
+report, even if this run never interleaved badly.
+
+Surface:
+
+- ``shared_field(name)`` — declare one shared-mutable field of one
+  instance. Returns a no-op handle unless ``TXFLOW_RACE_AUDIT=1`` (and
+  the lock audit is on — locksets come from lockgraph's held-stack), so
+  production paths pay one attribute access per probe. Declaration sites
+  carry the static intent annotation ``# txlint: shared(self._mtx)``
+  naming the lock that is SUPPOSED to guard the field (checked by the
+  ``shared-decl`` static pass; the runtime auditor then verifies the
+  intent against what threads actually held).
+- ``handle.note_read()`` / ``handle.note_write()`` — access probes,
+  placed inside the methods that touch the field (Python has no cheap
+  per-access memory instrumentation; the probes live where the field's
+  OWN class touches it, which is every access for lock-disciplined
+  code).
+- ``handle.handoff(reason)`` — sanctioned ownership transfer: resets the
+  field to virgin so the NEXT accessing thread becomes its exclusive
+  owner. This is the runtime counterpart of a suppression comment, for
+  protocols that synchronize by handoff rather than by a lock: the
+  deferred-apply ownership transfer (executor seam), and ticket/slot
+  handoffs where an Event's set/wait pair is the happens-before edge
+  (StagingRing slots: caller -> readback thread -> caller).
+
+State machine per field (Eraser fig. 3): VIRGIN -> EXCLUSIVE(owner
+thread; no lockset refinement — single-thread init needs no lock) ->
+SHARED (second thread read it: refine lockset but don't report, read-only
+sharing is benign) -> SHARED-MODIFIED (a write while shared: refine and
+REPORT when the lockset is empty). Reports carry both sites — the access
+that emptied the set and the last access from a different thread — and
+are deduped per (field name, racy site).
+
+tier-1 arms this for the whole suite via tests/conftest.py
+(``TXFLOW_RACE_AUDIT`` defaults to 1 there) and fails the run on any race
+report, mirroring the lock-audit gate. ``tools/lint.py --race-report``
+pretty-prints the report the gate dumps.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+
+from .lockgraph import LockAuditor, audit_enabled as _lock_audit_enabled
+from .lockgraph import default_auditor as _default_lock_auditor
+
+_ENV = "TXFLOW_RACE_AUDIT"
+
+# field states (Eraser)
+_VIRGIN = 0
+_EXCLUSIVE = 1
+_SHARED = 2
+_SHARED_MOD = 3
+
+_STATE_NAMES = {
+    _VIRGIN: "virgin",
+    _EXCLUSIVE: "exclusive",
+    _SHARED: "shared-read",
+    _SHARED_MOD: "shared-modified",
+}
+
+_MAX_RACES = 200
+
+
+def audit_enabled() -> bool:
+    """True when TXFLOW_RACE_AUDIT=1 AND the lock audit is on (locksets
+    are read from lockgraph's held-stack; without audited locks every
+    set would be empty and every field would read as racy)."""
+    return os.environ.get(_ENV, "") == "1" and _lock_audit_enabled()
+
+
+class RaceAuditor:
+    """Shared bookkeeping for every declared field: race reports (deduped
+    per (field, racy site)) and a per-field-NAME summary for the gate.
+
+    One plain (never audited — bookkeeping must not add edges to the
+    graph it audits) lock guards the tables; it is held only across
+    dict/set updates, never across user code."""
+
+    def __init__(self, lock_auditor: LockAuditor | None = None):
+        self._mtx = threading.Lock()
+        self._lock_auditor = lock_auditor
+        self._races: list[dict] = []
+        self._race_keys: set[tuple] = set()
+        # name -> aggregate over every field instance declared under it
+        self._summary: dict[str, dict] = {}
+
+    # -- lockset source --
+
+    def _held_tokens(self) -> tuple[frozenset, dict]:
+        aud = self._lock_auditor or _default_lock_auditor()
+        held = getattr(aud._tls, "held", None)
+        if not held:
+            return frozenset(), {}
+        toks = frozenset(l._tok for l in held)
+        names = {l._tok: l._name for l in held}
+        return toks, names
+
+    # -- declaration --
+
+    def declare(self, name: str) -> "SharedField":
+        field = SharedField(name, self)
+        with self._mtx:
+            s = self._summary.setdefault(
+                name,
+                {
+                    "fields": 0, "reads": 0, "writes": 0, "handoffs": 0,
+                    "max_threads": 0, "lockset": None, "racy": 0,
+                },
+            )
+            s["fields"] += 1
+        return field
+
+    # -- access (called by SharedField under its own state lock) --
+
+    def _note_summary(self, field: "SharedField", write: bool) -> None:
+        s = self._summary[field.name]
+        s["writes" if write else "reads"] += 1
+        s["max_threads"] = max(s["max_threads"], len(field._threads))
+        if field._state in (_SHARED, _SHARED_MOD):
+            names = sorted(field._lock_names.get(t, f"lock#{t}")
+                           for t in (field._lockset or ()))
+            # the gate reads this: the narrowest lockset any instance of
+            # this field name was ever down to while actually shared
+            prev = s["lockset"]
+            if prev is None or len(names) < len(prev):
+                s["lockset"] = names
+
+    def _report(self, field: "SharedField", write: bool, site: tuple,
+                prev_site: tuple | None, prev_thread: str | None) -> None:
+        # one report per (field, racy site): a hot loop hitting the same
+        # unlocked access pairs itself with a new prev_site every lap,
+        # so keying on the pair would flood the report with duplicates
+        key = (field.name, site)
+        with self._mtx:
+            if key in self._race_keys or len(self._races) >= _MAX_RACES:
+                return
+            self._race_keys.add(key)
+            self._summary[field.name]["racy"] += 1
+            self._races.append(
+                {
+                    "field": field.name,
+                    "access": "write" if write else "read",
+                    "site": _fmt_site(site),
+                    "other_site": _fmt_site(prev_site),
+                    "other_thread": prev_thread or "?",
+                    "thread": threading.current_thread().name,
+                    "stack": _short_stack(),
+                }
+            )
+
+    # -- reporting --
+
+    def races(self) -> list[dict]:
+        with self._mtx:
+            return list(self._races)
+
+    def report(self) -> dict:
+        with self._mtx:
+            summary = {
+                name: dict(s) for name, s in sorted(self._summary.items())
+            }
+            races = list(self._races)
+        return {"fields": summary, "races": races}
+
+    def reset(self) -> None:
+        with self._mtx:
+            self._races.clear()
+            self._race_keys.clear()
+            for s in self._summary.values():
+                s["racy"] = 0
+
+
+_DEFAULT = RaceAuditor()
+
+
+def default_race_auditor() -> RaceAuditor:
+    return _DEFAULT
+
+
+class SharedField:
+    """Per-instance Eraser state for one declared field.
+
+    A tiny per-field plain lock guards the state words; it is a leaf
+    (held only across the state update, no user code, no other lock)."""
+
+    __slots__ = (
+        "name", "_auditor", "_state", "_owner", "_threads", "_lockset",
+        "_lock_names", "_last_site", "_last_thread", "_mtx",
+    )
+
+    def __init__(self, name: str, auditor: RaceAuditor):
+        self.name = name
+        self._auditor = auditor
+        self._state = _VIRGIN
+        self._owner: int | None = None
+        self._threads: set[int] = set()
+        self._lockset: frozenset | None = None
+        self._lock_names: dict = {}
+        self._last_site: tuple | None = None
+        self._last_thread: str | None = None
+        self._mtx = threading.Lock()
+
+    def note_read(self) -> None:
+        self._access(False)
+
+    def note_write(self) -> None:
+        self._access(True)
+
+    def handoff(self, reason: str) -> None:
+        """Sanctioned ownership transfer (see module docstring). The
+        reason is required exactly like a suppression justification."""
+        assert reason, "handoff() requires a justification"
+        aud = self._auditor
+        with self._mtx:
+            self._state = _VIRGIN
+            self._owner = None
+            self._lockset = None
+            self._last_site = None
+            self._last_thread = None
+        with aud._mtx:
+            aud._summary[self.name]["handoffs"] += 1
+
+    def _access(self, write: bool) -> None:
+        tid = threading.get_ident()
+        aud = self._auditor
+        held, held_names = aud._held_tokens()
+        f = sys._getframe(2)  # the caller of note_read/note_write
+        site = (f.f_code.co_filename, f.f_lineno)
+        report_prev = None
+        with self._mtx:
+            st = self._state
+            self._threads.add(tid)
+            if st == _VIRGIN:
+                self._state = _EXCLUSIVE
+                self._owner = tid
+            elif st == _EXCLUSIVE:
+                if tid != self._owner:
+                    # first cross-thread access: candidate lockset is
+                    # whatever this thread holds right now
+                    self._lockset = held
+                    self._lock_names.update(held_names)
+                    self._state = _SHARED_MOD if write else _SHARED
+                    if self._state == _SHARED_MOD and not held:
+                        report_prev = (self._last_site, self._last_thread)
+            else:
+                self._lockset = (
+                    held if self._lockset is None else self._lockset & held
+                )
+                self._lock_names.update(held_names)
+                if write and st == _SHARED:
+                    self._state = _SHARED_MOD
+                if self._state == _SHARED_MOD and not self._lockset:
+                    report_prev = (self._last_site, self._last_thread)
+            prev_site, prev_thread = self._last_site, self._last_thread
+            self._last_site = site
+            self._last_thread = threading.current_thread().name
+            with aud._mtx:
+                aud._note_summary(self, write)
+        if report_prev is not None:
+            aud._report(self, write, site, prev_site, prev_thread)
+
+    def snapshot(self) -> dict:
+        with self._mtx:
+            return {
+                "name": self.name,
+                "state": _STATE_NAMES[self._state],
+                "threads": len(self._threads),
+                "lockset": sorted(
+                    self._lock_names.get(t, f"lock#{t}")
+                    for t in (self._lockset or ())
+                ) if self._lockset is not None else None,
+            }
+
+
+class _NullField:
+    """Audit-off handle: every probe is one no-op method call."""
+
+    __slots__ = ()
+    name = "<race-audit-off>"
+
+    def note_read(self) -> None:
+        pass
+
+    def note_write(self) -> None:
+        pass
+
+    def handoff(self, reason: str) -> None:
+        pass
+
+
+NULL_FIELD = _NullField()
+
+
+def shared_field(name: str, auditor: RaceAuditor | None = None):
+    """Declare one shared-mutable field. Returns the no-op handle unless
+    the race audit is armed (see audit_enabled). Sites carry the static
+    ``# txlint: shared(<lock>)`` intent annotation."""
+    if not audit_enabled():
+        return NULL_FIELD
+    return (auditor if auditor is not None else _DEFAULT).declare(name)
+
+
+def _fmt_site(site: tuple | None) -> str:
+    if site is None:
+        return "?"
+    return f"{os.path.basename(site[0])}:{site[1]}"
+
+
+def _short_stack(limit: int = 6) -> str:
+    frames = traceback.extract_stack()[:-2]
+    tail = frames[-limit:]
+    return " <- ".join(
+        f"{os.path.basename(f.filename)}:{f.lineno}" for f in reversed(tail)
+    )

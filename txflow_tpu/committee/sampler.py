@@ -37,10 +37,12 @@ from __future__ import annotations
 import hashlib
 
 from ..types.validator import ValidatorSet
+from ..utils.domains import COMMITTEE_V1
 
-# Domain-separation tag: versioned so a future sampler change cannot
-# silently elect a different committee for the same (chain_id, epoch)
-SEED_DOMAIN = b"txflow/committee/v1"
+# Domain-separation tag (registered in utils.domains): versioned so a
+# future sampler change cannot silently elect a different committee for
+# the same (chain_id, epoch)
+SEED_DOMAIN = COMMITTEE_V1
 
 
 def committee_seed(chain_id: str, epoch: int) -> bytes:

@@ -62,6 +62,7 @@ import weakref
 import numpy as np
 
 from ..analysis.lockgraph import make_lock
+from ..analysis.racegraph import shared_field
 from ..utils.clock import monotonic
 
 
@@ -133,6 +134,8 @@ class HostPrepPool:
         self._q: _queue.SimpleQueue = _queue.SimpleQueue()
         self._closed = False
         self._stats_mtx = make_lock("engine.HostPrepPool._stats_mtx")
+        # stats counters: every caller thread folds its tallies in here
+        self._sh_stats = shared_field("engine.HostPrepPool.stats")  # txlint: shared(self._stats_mtx)
         self.jobs_total = 0
         self.steals_total = 0
         self.pool_wait_s = 0.0
@@ -216,12 +219,17 @@ class HostPrepPool:
         inline = _Job(fn, lo, hi)
         inline.run()
         wait_s = 0.0
+        steals = 0
         for job in jobs:
             if job.done.is_set():
                 continue
-            # steal queued work (ours or another caller's) before parking
+            # steal queued work (ours or another caller's) before parking.
+            # Count locally — concurrent callers steal at once, and an
+            # unlocked `self.steals_total += 1` here loses increments
+            # (race-auditor finding; the counter folds in under the
+            # stats lock below with the rest of this call's tallies).
             while not job.done.is_set() and self._steal_one():
-                self.steals_total += 1
+                steals += 1
             if not job.done.is_set():
                 t0 = monotonic()
                 job.done.wait()
@@ -232,12 +240,15 @@ class HostPrepPool:
                 raise job.error
             results.append(job.result)
         with self._stats_mtx:
+            self._sh_stats.note_write()
             self.jobs_total += len(bounds)
+            self.steals_total += steals
             self.pool_wait_s += wait_s
         return results, wait_s
 
     def stats(self) -> dict:
         with self._stats_mtx:
+            self._sh_stats.note_read()
             return {
                 "backend": self.backend,
                 "workers": self.workers,
@@ -321,6 +332,8 @@ class ProcHostPrepPool:
         self._closed = False
         self._broken = False
         self._stats_mtx = make_lock("engine.ProcHostPrepPool._stats_mtx")
+        # shm stats + live-segment registry + call sequence + broken flag
+        self._sh_stats = shared_field("engine.ProcHostPrepPool.stats")  # txlint: shared(self._stats_mtx)
         self._shard_timeout = shard_timeout
         self._call_seq = 0
         self.shm_calls = 0
@@ -508,6 +521,7 @@ class ProcHostPrepPool:
             prep_proc.write_arrays(seg_in.buf, in_layout, ins)
             bounds = self._inner.shard_bounds(n)
             with self._stats_mtx:
+                self._sh_stats.note_write()
                 self._call_seq += 1
                 call = self._call_seq
             pending: dict[tuple, tuple[int, int]] = {}
@@ -547,6 +561,7 @@ class ProcHostPrepPool:
                 # and stop routing typed work at this pool
                 recompute.extend(pending.values())
                 with self._stats_mtx:
+                    self._sh_stats.note_write()
                     self._broken = True
             for lo, hi in recompute:
                 prep_proc.run_task(task, ins_views, outs_views, lo, hi)
@@ -556,6 +571,7 @@ class ProcHostPrepPool:
             outs_views = None
             self._untrack(seg_in, seg_out)
         with self._stats_mtx:
+            self._sh_stats.note_write()
             self.shm_calls += 1
             self.shm_bytes_total += in_bytes + out_bytes
             self.proc_jobs_total += len(bounds)
@@ -565,11 +581,13 @@ class ProcHostPrepPool:
 
     def _track(self, *segs) -> None:
         with self._stats_mtx:
+            self._sh_stats.note_write()
             for s in segs:
                 self._live_segs[s.name] = s
 
     def _untrack(self, *segs) -> None:
         with self._stats_mtx:
+            self._sh_stats.note_write()
             for s in segs:
                 self._live_segs.pop(s.name, None)
         for s in segs:
@@ -595,6 +613,7 @@ class ProcHostPrepPool:
     def stats(self) -> dict:
         s = self._inner.stats()
         with self._stats_mtx:
+            self._sh_stats.note_read()
             s.update(
                 backend=self.backend,
                 mp_method=self.mp_method,
@@ -631,6 +650,7 @@ class ProcHostPrepPool:
                 pass
         self._inner.close(timeout=timeout)
         with self._stats_mtx:
+            self._sh_stats.note_write()
             segs = list(self._live_segs.values())
             self._live_segs.clear()
         for s in segs:

@@ -228,9 +228,16 @@ def _generating_size(b: int, buckets, shards: int) -> int:
 
 
 def _copy_shape_set(s: set) -> set:
-    """Snapshot a set another thread may be growing (shapes_used): a
-    concurrent resize can raise RuntimeError mid-iteration — new shapes
-    are rare (one per first-dispatch), so a short retry always wins."""
+    """Snapshot a set another thread may be growing (shapes_used).
+
+    The verifier's ``_ShapeSet`` takes its lock in ``snapshot()`` for a
+    consistent copy; the retry loop remains as a fallback for plain sets
+    (tests hand in bare ``set()`` doubles), where a concurrent resize can
+    raise RuntimeError mid-iteration — new shapes are rare (one per
+    first-dispatch), so a short retry always wins."""
+    snap = getattr(s, "snapshot", None)
+    if snap is not None:
+        return snap()
     for _ in range(8):
         try:
             return set(s)

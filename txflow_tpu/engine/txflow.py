@@ -51,6 +51,7 @@ from ..trace.tracer import (
 from ..types import TxVote, TxVoteSet
 from ..types.validator import ValidatorSet
 from ..analysis.lockgraph import make_rlock
+from ..analysis.racegraph import shared_field
 from ..utils.cache import make_lru
 from ..utils.clock import monotonic
 from ..utils.config import EngineConfig
@@ -332,6 +333,10 @@ class TxFlow:
             if buckets:
                 self._drain_cap = max(self._drain_cap, max(buckets))
         self.vote_sets: dict[str, TxVoteSet] = {}  # in-flight only
+        # in-flight vote sets: the step/prep thread, the route stage, the
+        # committer, the sync apply path, and RPC snapshot readers all
+        # cross here under the engine RLock
+        self._sh_votesets = shared_field("engine.TxFlow.vote_sets")  # txlint: shared(self._mtx)
         self._committed = make_lru(1 << 16)  # recently committed tx hashes
         # ingest-log cursor: each pool entry is visited by step() exactly
         # once via the stable-cursor walk (in-batch repeats re-queue on
@@ -1159,6 +1164,7 @@ class TxFlow:
             keys, votes, slots = prep.keys, prep.votes, prep.slots
             slot_of: dict[str, int] = {}
             drop_now: list[bytes] = []
+            self._sh_votesets.note_read()
             for bi, (key, vote) in enumerate(batch):
                 if self._committed.__contains__(_hash_key(vote.tx_hash)) or (
                     vote.tx_hash not in self.vote_sets
@@ -1398,6 +1404,7 @@ class TxFlow:
         spec_t: list[float] = []
         spec_sids: list[int] = []
         with self._mtx:
+            self._sh_votesets.note_write()
             self.metrics.batch_size.observe(len(votes))
             self.metrics.verified_votes.add(int(result.valid.sum()))
 
@@ -1659,6 +1666,7 @@ class TxFlow:
 
     def _add_vote_scalar(self, vote: TxVote) -> tuple[bool, Exception | None]:
         """Reference-exact scalar path (used by tests as the golden engine)."""
+        self._sh_votesets.note_write()
         if self._committed.__contains__(_hash_key(vote.tx_hash)) or (
             vote.tx_hash not in self.vote_sets and self.tx_store.has_tx(vote.tx_hash)
         ):
@@ -1705,6 +1713,7 @@ class TxFlow:
         both happen here, atomically with the _committed mark — see
         _enqueue_commit's comments for both races."""
         quorum_votes = vs.get_votes()
+        self._sh_votesets.note_write()
         self.vote_sets.pop(vs.tx_hash, None)
         self._committed.push(_hash_key(vs.tx_hash))
         self._trace_commit_begin(vs.tx_hash)
@@ -1717,6 +1726,7 @@ class TxFlow:
         """Inline commit (scalar golden path / pipeline_commits=False)."""
         quorum_votes = vs.get_votes()
         # fixed leak: drop the in-flight set, remember the hash
+        self._sh_votesets.note_write()
         self.vote_sets.pop(vs.tx_hash, None)
         self._committed.push(_hash_key(vs.tx_hash))
         self._commit_effects(vs, quorum_votes, purge_batch)
@@ -1730,6 +1740,7 @@ class TxFlow:
         carrying this tx as a vtx may have purged the mempool (its claim
         saw our _committed mark and skipped delivery, counting on us), and
         a late get_tx(None) would silently drop the apply."""
+        self._sh_votesets.note_write()
         self.vote_sets.pop(vs.tx_hash, None)
         self._committed.push(_hash_key(vs.tx_hash))
         self._decided_count += 1
@@ -1967,6 +1978,7 @@ class TxFlow:
                 self.tx_store.has_tx(tx_hash)
             ):
                 return False
+            self._sh_votesets.note_write()
             live = self.vote_sets.pop(tx_hash, None)
             self._committed.push(_hash_key(tx_hash))
             self._decided_count += 1
@@ -2057,6 +2069,7 @@ class TxFlow:
         TxVoteSet.stake() takes the per-set lock, so read it outside the
         engine lock to keep the snapshot cheap under load."""
         with self._mtx:
+            self._sh_votesets.note_read()
             sets = list(self.vote_sets.values())
         return [(vs.tx_hash, vs.stake()) for vs in sets]
 
@@ -2086,6 +2099,7 @@ class TxFlow:
                 self.tx_store.has_tx(tx_hash)
             ):
                 return True
+            self._sh_votesets.note_read()
             if tx_hash not in self.vote_sets:
                 return False
             # An in-flight vote set only reserves the tx if a fast quorum
@@ -2128,6 +2142,7 @@ class TxFlow:
                 self.tx_store.has_tx(tx_hash)
             ):
                 return False
+            self._sh_votesets.note_write()
             vs = self.vote_sets.pop(tx_hash, None)
             self._committed.push(_hash_key(tx_hash))
             # durable marker: the in-memory LRU can evict, and a tx that
@@ -2241,6 +2256,7 @@ class TxFlow:
             # latched certificates untouched — TxVoteSet.revalidate)
             dropped = 0
             newly_quorate = []
+            self._sh_votesets.note_write()
             for vs in list(self.vote_sets.values()):
                 d, quorate = vs.revalidate(val_set)
                 dropped += d

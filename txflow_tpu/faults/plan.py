@@ -21,6 +21,7 @@ import threading
 from dataclasses import dataclass
 
 from ..p2p.base import CHANNEL_MEMPOOL, CHANNEL_SYNC, CHANNEL_TXVOTE
+from ..utils.domains import FAULTPLAN_LINK
 
 # default chaos scope: the at-least-once gossip channels. Consensus
 # channels (0x20-0x22) are push-once state-machine traffic; faulting them
@@ -115,7 +116,8 @@ class FaultPlan:
         rng = self._links.get(key)
         if rng is None:
             digest = hashlib.sha256(
-                b"faultplan|%d|%s|%s" % (self.spec.seed, src.encode(), dst.encode())
+                FAULTPLAN_LINK
+                + b"|%d|%s|%s" % (self.spec.seed, src.encode(), dst.encode())
             ).digest()
             rng = random.Random(int.from_bytes(digest[:8], "little"))
             self._links[key] = rng

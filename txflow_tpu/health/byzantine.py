@@ -49,6 +49,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..analysis.lockgraph import make_lock
+from ..analysis.racegraph import shared_field
 from ..utils.clock import monotonic
 from ..utils.metrics import ByzantineMetrics
 
@@ -147,6 +148,10 @@ class ByzantineLedger:
         # cfg at judge time — see _eff_thresholds / committee_rescale.
         self._committee_frac = 1.0
         self._mtx = make_lock("health.ByzantineLedger._mtx")
+        # peer records + pid map + totals + committee fraction: gossip
+        # receive threads, the engine route tail, the sync client, and
+        # the node's epoch thread all cross here
+        self._sh_state = shared_field("health.ByzantineLedger.records")  # txlint: shared(self._mtx)
         self._peers: dict[str, _PeerRecord] = {}
         self._pids: dict[int, str] = {}  # pool sender id -> node_id
         # process totals (cheap snapshot without walking peers)
@@ -169,13 +174,16 @@ class ByzantineLedger:
         Returns the effective ``(min_samples, max_bad_rate)``."""
         f = min(max(float(fraction), 0.0), 1.0)
         with self._mtx:
+            self._sh_state.note_write()
             self._committee_frac = f
-        return self._eff_thresholds()
+            return self._eff_thresholds_locked()
 
-    def _eff_thresholds(self) -> tuple[int, float]:
-        """Effective breaker thresholds under the current committee
-        fraction, derived from the LIVE cfg values (drills arm the
-        breaker by mutating cfg mid-run)."""
+    def _eff_thresholds_locked(self) -> tuple[int, float]:
+        """Under _mtx (``_committee_frac`` is written by the node's epoch
+        thread while gossip receive threads judge — the race auditor
+        caught the old unlocked read here): effective breaker thresholds
+        under the current committee fraction, derived from the LIVE cfg
+        values (drills arm the breaker by mutating cfg mid-run)."""
         f = self._committee_frac
         if f >= 1.0:
             return self.cfg.min_samples, self.cfg.max_bad_rate
@@ -191,6 +199,7 @@ class ByzantineLedger:
         node_id so engine-side verdict attribution can reach the
         scoreboard, which keys on node ids."""
         with self._mtx:
+            self._sh_state.note_write()
             self._pids[pid] = node_id
             if node_id not in self._peers:
                 self._peers[node_id] = _PeerRecord(node_id)
@@ -207,6 +216,7 @@ class ByzantineLedger:
         if now is None:
             now = monotonic()
         with self._mtx:
+            self._sh_state.note_read()
             rec = self._peers.get(node_id)
             return rec is not None and now < rec.quarantined_until
 
@@ -224,6 +234,7 @@ class ByzantineLedger:
         trip = None
         m = self.metrics
         with self._mtx:
+            self._sh_state.note_write()
             rec = self._rec(node_id)
             rec.relayed += kept
             rec.win_events += kept
@@ -263,6 +274,7 @@ class ByzantineLedger:
             now = monotonic()
         per_peer: dict[str, int] = {}
         with self._mtx:
+            self._sh_state.note_write()
             for pid in origins:
                 nid = self._pids.get(pid)
                 if nid is None:
@@ -304,6 +316,7 @@ class ByzantineLedger:
         if now is None:
             now = monotonic()
         with self._mtx:
+            self._sh_state.note_write()
             rec = self._rec(node_id)
             rec.sync_strikes += 1
             rec.strikes += 1
@@ -321,7 +334,7 @@ class ByzantineLedger:
         cfg = self.cfg
         trip = None
         if now >= rec.quarantined_until:
-            eff_min, eff_rate = self._eff_thresholds()
+            eff_min, eff_rate = self._eff_thresholds_locked()
             bad_trip = (
                 rec.win_events >= eff_min
                 and rec.win_bad / rec.win_events >= eff_rate
@@ -363,6 +376,7 @@ class ByzantineLedger:
 
     def strikes_of(self, node_id: str) -> int:
         with self._mtx:
+            self._sh_state.note_read()
             rec = self._peers.get(node_id)
             return rec.strikes if rec is not None else 0
 
@@ -370,6 +384,7 @@ class ByzantineLedger:
         if now is None:
             now = monotonic()
         with self._mtx:
+            self._sh_state.note_read()
             peers = {}
             quarantined = []
             for nid, rec in self._peers.items():
@@ -397,7 +412,7 @@ class ByzantineLedger:
                 "breaker": dict(
                     zip(
                         ("min_samples", "max_bad_rate"),
-                        self._eff_thresholds(),
+                        self._eff_thresholds_locked(),
                     )
                 ),
                 "peers": peers,

@@ -42,6 +42,7 @@ import weakref
 
 from ..analysis.lockgraph import make_lock
 from ..utils import clock
+from ..utils.domains import NETEM_LINK
 from .profiles import NetProfile, get_profile
 
 _STAT_KEYS = (
@@ -230,7 +231,8 @@ class LinkShaper:
             rng = self._rngs.get(key)
             if rng is None:
                 digest = hashlib.sha256(
-                    b"netem|%d|%s|%s"
+                    NETEM_LINK
+                    + b"|%d|%s|%s"
                     % (self.seed, src.encode(), dst.encode())
                 ).digest()
                 rng = random.Random(int.from_bytes(digest[:8], "big"))

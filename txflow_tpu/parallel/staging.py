@@ -45,14 +45,21 @@ import threading
 import numpy as np
 
 from ..analysis.lockgraph import make_lock
+from ..analysis.racegraph import shared_field
 from ..utils.clock import monotonic
 
 
 class StageSlot:
-    """One in-flight readback: device array in, host array (or error) out."""
+    """One in-flight readback: device array in, host array (or error) out.
+
+    No lock guards the buffer fields: ownership moves caller -> readback
+    thread -> waiter, with the ring queue (under ``_q_mtx``) and the
+    ``_done`` Event's set()/wait() pair as the happens-before edges. The
+    race auditor sees this as sanctioned handoffs, not a lockset."""
 
     __slots__ = (
-        "_dev", "_host", "_error", "_done", "readback_s", "_waited", "_queued"
+        "_dev", "_host", "_error", "_done", "readback_s", "_waited",
+        "_queued", "_sh",
     )
 
     def __init__(self, dev):
@@ -63,9 +70,12 @@ class StageSlot:
         self.readback_s = 0.0
         self._waited = False
         self._queued = False
+        self._sh = shared_field("parallel.StageSlot.buffer")  # txlint: shared(handoff)
+        self._sh.note_write()
 
     def _run(self) -> None:
         t0 = monotonic()
+        self._sh.note_write()
         try:
             self._host = np.asarray(self._dev)
         except BaseException as exc:  # re-raised at wait()
@@ -73,11 +83,15 @@ class StageSlot:
         finally:
             self._dev = None  # drop the device ref as soon as bytes land
             self.readback_s = monotonic() - t0
+            self._sh.handoff(
+                "Event set()/wait() is the happens-before edge to the waiter"
+            )
             self._done.set()
 
     def wait(self):
         """Block until the readback lands; returns the host array."""
         self._done.wait()
+        self._sh.note_read()
         if self._error is not None:
             raise self._error
         return self._host
@@ -100,6 +114,10 @@ class StagingRing:
         self._q_mtx = make_lock("parallel.StagingRing._q_mtx")
         self._q_cv = threading.Condition(self._q_mtx)
         self._stats_mtx = make_lock("parallel.StagingRing._stats_mtx")
+        # queue + in-flight count: submitters, waiters, and the readback
+        # thread all cross here
+        self._sh_q = shared_field("parallel.StagingRing.queue")  # txlint: shared(self._q_mtx)
+        self._sh_stats = shared_field("parallel.StagingRing.stats")  # txlint: shared(self._stats_mtx)
         self._closed = False
         self.slots_total = 0
         self.readback_s = 0.0
@@ -132,10 +150,16 @@ class StagingRing:
                 # still gets its bytes (drain path, never lossy)
                 return self._sync_slot(dev, fallback=False)
             slot._queued = True
+            slot._sh.handoff(
+                "queued under _q_mtx; readback thread is sole owner "
+                "until _done.set()"
+            )
+            self._sh_q.note_write()
             self._q.append(slot)
             self._in_flight += 1
             self._q_cv.notify()
         with self._stats_mtx:
+            self._sh_stats.note_write()
             self.slots_total += 1
         return slot
 
@@ -143,6 +167,7 @@ class StagingRing:
         slot = StageSlot(dev)
         slot._run()
         with self._stats_mtx:
+            self._sh_stats.note_write()
             self.slots_total += 1
             self.readback_s += slot.readback_s
             if fallback:
@@ -164,6 +189,7 @@ class StagingRing:
             w = monotonic() - t0
             release = False
             with self._q_mtx:
+                self._sh_q.note_write()
                 if slot._queued and not slot._waited:
                     slot._waited = True
                     self._in_flight -= 1
@@ -173,6 +199,7 @@ class StagingRing:
                 # submit (their readback ran ON the caller: nothing hidden)
                 self._sem.release()
                 with self._stats_mtx:
+                    self._sh_stats.note_write()
                     self.result_wait_s += w
                     self.readback_s += slot.readback_s
                     self.hidden_s += max(slot.readback_s - w, 0.0)
@@ -185,6 +212,7 @@ class StagingRing:
                     self._q_cv.wait()
                 if not self._q and self._closed:
                     return
+                self._sh_q.note_write()
                 slot = self._q.pop(0)
             if slot is None:
                 return
@@ -192,6 +220,8 @@ class StagingRing:
 
     def stats(self) -> dict:
         with self._stats_mtx, self._q_mtx:
+            self._sh_stats.note_read()
+            self._sh_q.note_read()
             return {
                 "depth": self.depth,
                 "slots_total": self.slots_total,
@@ -211,6 +241,7 @@ class StagingRing:
         with self._q_cv:
             if self._closed:
                 return
+            self._sh_q.note_write()
             self._closed = True
             self._q_cv.notify_all()
         self._thread.join(timeout=timeout)

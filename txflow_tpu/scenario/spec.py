@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 
 from ..faults.stake import stake_distribution
 from ..netem.profiles import PROFILES, profile_names
+from ..utils.domains import SCENARIO_AXIS
 
 # Axis order is part of the spec: tile ids, cross-product walk order and
 # the smoke diagonal all derive from it. The FIRST level of each axis is
@@ -77,7 +78,7 @@ def axis_seed(seed: int, axis: str, level: str) -> int:
     """The disjoint PRNG domain for one (grid seed, axis, level): no two
     axes — and no two levels of one axis — ever share a stream."""
     digest = hashlib.sha256(
-        b"scenario|%d|%s|%s" % (seed, axis.encode(), level.encode())
+        SCENARIO_AXIS + b"|%d|%s|%s" % (seed, axis.encode(), level.encode())
     ).digest()
     return int.from_bytes(digest[:8], "little")
 

@@ -64,6 +64,8 @@ import threading
 
 import numpy as np
 
+from ..analysis.lockgraph import make_lock
+from ..analysis.racegraph import shared_field
 from ..trace.tracer import (
     NULL_TRACER,
     SPAN_SYNC_APPLY,
@@ -140,7 +142,11 @@ class SyncManager:
         self._rng = random.Random(self.config.seed)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._mtx = threading.Lock()
+        self._mtx = make_lock("sync.SyncManager._mtx")
+        # adverts + bans: written by gossip receive threads (note_advert
+        # from reactor callbacks) and the sync thread's strike path,
+        # read by the chooser — audited like every other cross-thread map
+        self._sh_peers = shared_field("sync.SyncManager.adverts")  # txlint: shared(self._mtx)
         # peer node_id -> (advertised seq_count, advertised height)
         self._adverts: dict[str, tuple[int, int]] = {}
         self._banned: dict[str, float] = {}  # node_id -> ban expiry
@@ -190,10 +196,12 @@ class SyncManager:
 
     def note_status(self, node_id: str, seq_count: int, height: int) -> None:
         with self._mtx:
+            self._sh_peers.note_write()
             self._adverts[node_id] = (seq_count, height)
 
     def note_peer_gone(self, node_id: str) -> None:
         with self._mtx:
+            self._sh_peers.note_write()
             self._adverts.pop(node_id, None)
 
     def note_response(self, node_id: str, *resp) -> None:
@@ -219,6 +227,7 @@ class SyncManager:
         cycle while the fast path is fine."""
         now = monotonic()
         with self._mtx:
+            self._sh_peers.note_read()
             return {
                 n: a
                 for n, a in self._adverts.items()
@@ -232,6 +241,7 @@ class SyncManager:
     def snapshot(self) -> dict:
         adverts = self._servable_adverts()
         with self._mtx:
+            self._sh_peers.note_read()
             banned = [n for n, t in self._banned.items() if t > monotonic()]
         return {
             "state": _STATE_NAMES.get(self.state, str(self.state)),
@@ -294,6 +304,7 @@ class SyncManager:
     def _expire_bans(self) -> None:
         now = monotonic()
         with self._mtx:
+            self._sh_peers.note_write()
             for nid in [n for n, t in self._banned.items() if t <= now]:
                 del self._banned[nid]
 
@@ -352,6 +363,7 @@ class SyncManager:
             if self.metrics is not None:
                 self.metrics.byzantine_strikes.add(1)
             with self._mtx:
+                self._sh_peers.note_write()
                 self._banned[peer.node_id] = monotonic() + cfg.byzantine_ban
                 # a proven liar's advert is worthless — drop it so lag()
                 # reflects only peers we would actually fetch from (it

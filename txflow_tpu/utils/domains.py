@@ -1,0 +1,60 @@
+"""Central registry of PRNG/hash domain-separation tags.
+
+Every deterministic stream in this repo is seeded from
+``sha256(tag | ... public inputs ...)``. The tags MUST be pairwise
+distinct: two subsystems sharing a tag silently share (or perturb) a
+stream — the committee-election kind of bug that only shows up as a
+quorum fork months later. This module is the ONE place tags are spelled;
+``_register`` fails fast at import on a duplicate name or duplicate tag
+bytes, and the ``seed-domain`` txlint pass fails the tree on any inline
+raw domain literal outside this file.
+
+Adding a domain: register the tag here, import the constant at the use
+site, and keep the byte layout of the derived seed at the use site (the
+registry owns WHICH bytes prefix the stream, not how the suffix is
+packed — endianness and field packing are caller contracts pinned by
+tests/test_domains.py).
+"""
+
+from __future__ import annotations
+
+_REGISTRY: dict[str, bytes] = {}
+
+
+def _register(name: str, tag: bytes) -> bytes:
+    if name in _REGISTRY:
+        raise ValueError(f"duplicate domain name {name!r}")
+    for other_name, other_tag in _REGISTRY.items():
+        if other_tag == tag:
+            raise ValueError(
+                f"domain tag {tag!r} already registered as {other_name!r}"
+            )
+    _REGISTRY[name] = tag
+    return tag
+
+
+def registered_domains() -> dict[str, bytes]:
+    """Snapshot of the registry (name -> tag), for tests and tooling."""
+    return dict(_REGISTRY)
+
+
+# -- the domains ------------------------------------------------------------
+
+# Per-epoch committee sampling (committee/sampler.py): versioned so a
+# future sampler change cannot silently elect a different committee for
+# the same (chain_id, epoch).
+COMMITTEE_V1 = _register("committee-sampler", b"txflow/committee/v1")
+
+# Scenario grid axis streams (scenario/spec.py): one disjoint stream per
+# (grid seed, axis, level) so no two axes — and no two levels of one
+# axis — ever share randomness.
+SCENARIO_AXIS = _register("scenario-axis", b"scenario")
+
+# Chaos fault plans (faults/plan.py): one stream per directed link,
+# reproducible from the spec seed alone.
+FAULTPLAN_LINK = _register("faultplan-link", b"faultplan")
+
+# Network weather (netem/shaper.py): per-directed-link jitter/loss
+# streams, domain-separated from the fault planner so a shaper never
+# consumes or perturbs chaos streams.
+NETEM_LINK = _register("netem-link", b"netem")

@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from .analysis.lockgraph import make_lock, note_blocking
+from .analysis.racegraph import shared_field
 from .crypto import ed25519 as host_ed
 from .ops import ed25519_batch, tally
 from .types.validator import ValidatorSet
@@ -88,6 +89,9 @@ class VerifyCache:
         self.capacity = capacity
         self.claim_ttl = claim_ttl
         self._mtx = make_lock("verifier.VerifyCache._mtx")
+        # verdicts + in-flight claims: every co-located engine's verify
+        # path races through these tables
+        self._sh_claims = shared_field("verifier.VerifyCache.claims")  # txlint: shared(self._mtx)
         self._d: OrderedDict[bytes, bool] = OrderedDict()
         # in-flight claims: key -> monotonic claim time. Without claims,
         # co-located engines that miss on the SAME votes all ship them to
@@ -133,6 +137,7 @@ class VerifyCache:
         now = time.monotonic()
         stale = now - self.claim_ttl
         with self._mtx:
+            self._sh_claims.note_write()
             d = self._d
             infl = self._inflight
             for i, k in enumerate(keys):
@@ -158,11 +163,13 @@ class VerifyCache:
     def release_many(self, keys: list[bytes]) -> None:
         """Drop claims without storing results (verify failed/raised)."""
         with self._mtx:
+            self._sh_claims.note_write()
             for k in keys:
                 self._inflight.pop(k, None)
 
     def store_many(self, pairs: list[tuple[bytes, bool]]) -> None:
         with self._mtx:
+            self._sh_claims.note_write()
             d = self._d
             infl = self._inflight
             for k, v in pairs:
@@ -177,6 +184,7 @@ class VerifyCache:
         flight but slow. Claims already released/stored are left alone."""
         now = time.monotonic()
         with self._mtx:
+            self._sh_claims.note_write()
             infl = self._inflight
             for k in keys:
                 if k in infl:
@@ -591,6 +599,43 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, n - 1).bit_length()
 
 
+class _ShapeSet(set):
+    """Lock-guarded ``shapes_used``: the engine thread adds shapes from
+    the dispatch paths while the BackgroundWarmer thread probes
+    membership, discards failed warm dispatches, and snapshots the set —
+    a plain set here is a real data race (the old ``_copy_shape_set``
+    RuntimeError retry loop papered over concurrent-resize crashes, and
+    the race auditor flags the unlocked add/discard pair). Subclassing
+    ``set`` keeps reader idiom (``set(dv.shapes_used)``, ``in``) intact;
+    mutators and membership go through a leaf lock, and readers that want
+    a consistent copy call ``snapshot()``."""
+
+    def __init__(self, name: str):
+        super().__init__()
+        self._mtx = make_lock(name + "._mtx")
+        self._sh = shared_field(name)  # txlint: shared(self._mtx)
+
+    def add(self, shape) -> None:
+        with self._mtx:
+            self._sh.note_write()
+            set.add(self, shape)
+
+    def discard(self, shape) -> None:
+        with self._mtx:
+            self._sh.note_write()
+            set.discard(self, shape)
+
+    def __contains__(self, shape) -> bool:
+        with self._mtx:
+            self._sh.note_read()
+            return set.__contains__(self, shape)
+
+    def snapshot(self) -> set:
+        with self._mtx:
+            self._sh.note_read()
+            return set(self)
+
+
 class _DeviceStage:
     """One epoch's device constants, bundled so the submit paths read a
     SINGLE attribute and can never mix one epoch's pubkey tables with
@@ -676,7 +721,7 @@ class DeviceVoteVerifier:
         # every (kind, batch-bucket, slot-bucket) shape this verifier has
         # dispatched — the shape-warm registry (engine.shapes) snapshots it
         # after prewarm and diffs it after a run to detect in-run compiles
-        self.shapes_used: set[tuple] = set()
+        self.shapes_used: set[tuple] = _ShapeSet("verifier.DeviceVoteVerifier.shapes_used")
         # kick the native prep build NOW (cc -O3, seconds when stale): the
         # first lazy build would otherwise land inside the first verify
         # step, stalling the engine right as the node comes under load
